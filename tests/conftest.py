@@ -1,0 +1,17 @@
+"""Test session config.
+
+Device tests run on the CPU backend with 8 virtual devices so multi-chip
+sharding logic (`shard_map`/`psum` over a Mesh) is exercised without a TPU
+pod — the rebuild's analog of the reference testing multi-node behavior
+against single-node containers (SURVEY.md §4). Must run before any jax
+import anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

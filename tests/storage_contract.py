@@ -1,0 +1,244 @@
+"""The storage contract suite — every backend must pass it.
+
+Reference semantics: the abstract IT classes published as ``zipkin-tests``
+(``ITStorage``, ``ITSpanStore``, ``ITDependencies``, ``ITTraces``,
+``ITServiceAndSpanNames``, ``ITAutocompleteTags`` — SURVEY.md §4). Subclass
+and override ``make_storage`` to run the whole suite against a backend; the
+in-memory oracle and the TPU store both do.
+"""
+
+from __future__ import annotations
+
+from tests.fixtures import BACKEND, CLIENT_SPAN, DB, FRONTEND, TODAY, TODAY_US, TRACE
+from zipkin_tpu.model.span import DependencyLink, Endpoint, Kind, Span
+from zipkin_tpu.storage.spi import QueryRequest, StorageComponent
+
+DAY_MS = 86_400_000
+QUERY_TS = TODAY + 1000 * 60 * 60  # an hour after the fixture trace
+
+
+class StorageContract:
+    """Mix into a test class; define ``make_storage``."""
+
+    def make_storage(self, **kwargs) -> StorageComponent:
+        raise NotImplementedError
+
+    # -- helpers -----------------------------------------------------------
+
+    def fresh(self, **kwargs) -> StorageComponent:
+        return self.make_storage(**kwargs)
+
+    def store(self, storage: StorageComponent, spans) -> None:
+        storage.span_consumer().accept(list(spans)).execute()
+
+    def query(self, storage, **kw):
+        kw.setdefault("end_ts", QUERY_TS)
+        kw.setdefault("lookback", DAY_MS)
+        kw.setdefault("limit", 10)
+        return storage.span_store().get_traces_query(QueryRequest(**kw)).execute()
+
+    # -- lifecycle (ITStorage) --------------------------------------------
+
+    def test_check_ok(self):
+        assert self.fresh().check().ok
+
+    def test_accept_empty_is_ok(self):
+        storage = self.fresh()
+        self.store(storage, [])
+
+    # -- traces (ITTraces) -------------------------------------------------
+
+    def test_get_trace_returns_merged_spans(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        spans = storage.span_store().get_trace(TRACE[0].trace_id).execute()
+        assert sorted(s.id for s in spans) == sorted(s.id for s in TRACE)
+
+    def test_get_trace_unknown_is_empty(self):
+        storage = self.fresh()
+        assert storage.span_store().get_trace("1234") .execute() == []
+
+    def test_get_trace_dedups_duplicate_reports(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        self.store(storage, [TRACE[1]])  # duplicate report
+        spans = storage.span_store().get_trace(TRACE[0].trace_id).execute()
+        assert len(spans) == len(TRACE)
+
+    def test_get_many_traces(self):
+        storage = self.fresh()
+        other = Span.create("feed", "1", name="x", timestamp=TODAY_US, duration=1,
+                            local_endpoint=FRONTEND)
+        self.store(storage, TRACE)
+        self.store(storage, [other])
+        got = storage.traces().get_traces([TRACE[0].trace_id, "feed"]).execute()
+        assert len(got) == 2
+
+    def test_strict_trace_id_distinguishes_renditions(self):
+        storage = self.fresh(strict_trace_id=True)
+        low64 = TRACE[0].trace_id[16:]
+        self.store(storage, TRACE)
+        assert storage.span_store().get_trace(low64).execute() == []
+
+    def test_lenient_trace_id_collapses_renditions(self):
+        storage = self.fresh(strict_trace_id=False)
+        low64 = TRACE[0].trace_id[16:]
+        self.store(storage, TRACE)
+        got = storage.span_store().get_trace(low64).execute()
+        assert len(got) == len(TRACE)
+
+    # -- search (ITSpanStore) ----------------------------------------------
+
+    def test_query_by_service(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        assert len(self.query(storage, service_name="frontend")) == 1
+        assert len(self.query(storage, service_name="backend")) == 1
+        assert self.query(storage, service_name="nope") == []
+
+    def test_query_by_span_name(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        assert len(self.query(storage, span_name="get /api")) == 1
+        assert self.query(storage, span_name="nope") == []
+
+    def test_query_by_remote_service_name(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        assert len(self.query(storage, service_name="backend",
+                              remote_service_name="mysql")) == 1
+        assert self.query(storage, service_name="frontend",
+                          remote_service_name="mysql") == []
+
+    def test_query_by_tag(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        assert len(self.query(storage, annotation_query={"error": ""})) == 1
+        assert len(self.query(
+            storage,
+            annotation_query={"error": "Deadlock found when trying to get lock"},
+        )) == 1
+        assert self.query(storage, annotation_query={"error": "other"}) == []
+
+    def test_query_by_annotation_value(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        assert len(self.query(storage, annotation_query={"ws": ""})) == 1
+
+    def test_tag_must_be_on_selected_service(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        # error tag lives on a backend span, not a frontend one
+        assert self.query(
+            storage, service_name="frontend", annotation_query={"error": ""}
+        ) == []
+        assert len(self.query(
+            storage, service_name="backend", annotation_query={"error": ""}
+        )) == 1
+
+    def test_query_by_duration(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        assert len(self.query(storage, min_duration=300_000)) == 1  # root is 350ms
+        assert self.query(storage, min_duration=400_000) == []
+        assert len(self.query(
+            storage, min_duration=70_000, max_duration=90_000
+        )) == 1  # db call 80ms
+
+    def test_query_window_excludes_old_traces(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        assert self.query(storage, end_ts=TODAY - DAY_MS) == []
+        assert self.query(storage, end_ts=QUERY_TS, lookback=1) == []
+
+    def test_query_limit_newest_first(self):
+        storage = self.fresh()
+        for i in range(5):
+            storage_span = Span.create(
+                f"{i + 1:x}", "1", name="op", timestamp=TODAY_US + i * 1_000_000,
+                duration=10, local_endpoint=FRONTEND,
+            )
+            self.store(storage, [storage_span])
+        got = self.query(storage, limit=3)
+        assert len(got) == 3
+        ts = [t[0].timestamp for t in got]
+        assert ts == sorted(ts, reverse=True)
+
+    def test_search_disabled_returns_empty(self):
+        storage = self.fresh(search_enabled=False)
+        self.store(storage, TRACE)
+        assert self.query(storage, service_name="frontend") == []
+        assert storage.service_and_span_names().get_service_names().execute() == []
+        # but direct trace lookup still works
+        assert storage.span_store().get_trace(TRACE[0].trace_id).execute() != []
+
+    # -- names (ITServiceAndSpanNames) -------------------------------------
+
+    def test_service_names(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        names = storage.service_and_span_names().get_service_names().execute()
+        assert names == ["backend", "frontend"]
+
+    def test_span_names(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        names = storage.service_and_span_names().get_span_names("frontend").execute()
+        assert names == ["get /", "get /api"]
+        assert storage.service_and_span_names().get_span_names("nope").execute() == []
+
+    def test_remote_service_names(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        sasn = storage.service_and_span_names()
+        assert sasn.get_remote_service_names("backend").execute() == ["mysql"]
+        assert sasn.get_remote_service_names("frontend").execute() == []
+
+    # -- dependencies (ITDependencies) -------------------------------------
+
+    def test_dependencies_of_canonical_trace(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        links = storage.span_store().get_dependencies(QUERY_TS, DAY_MS).execute()
+        assert sorted(links, key=lambda x: x.parent) == [
+            DependencyLink("backend", "mysql", 1, 1),
+            DependencyLink("frontend", "backend", 1, 0),
+        ]
+
+    def test_dependencies_respect_window(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        links = storage.span_store().get_dependencies(TODAY - DAY_MS, DAY_MS).execute()
+        assert links == []
+
+    def test_dependencies_accumulate(self):
+        storage = self.fresh()
+        self.store(storage, TRACE)
+        moved = [
+            Span.create(
+                s.trace_id[:-1] + "f", s.id, parent_id=s.parent_id, kind=s.kind,
+                name=s.name, timestamp=s.timestamp, duration=s.duration,
+                local_endpoint=s.local_endpoint, remote_endpoint=s.remote_endpoint,
+                annotations=s.annotations, tags=s.tags, shared=s.shared,
+            )
+            for s in TRACE
+        ]
+        self.store(storage, moved)
+        links = storage.span_store().get_dependencies(QUERY_TS, DAY_MS).execute()
+        by_pair = {(x.parent, x.child): x for x in links}
+        assert by_pair[("frontend", "backend")].call_count == 2
+        assert by_pair[("backend", "mysql")].error_count == 2
+
+    # -- autocomplete (ITAutocompleteTags) ---------------------------------
+
+    def test_autocomplete_tags(self):
+        storage = self.fresh(autocomplete_keys=["env", "cluster"])
+        span = Span.create(
+            "1", "2", timestamp=TODAY_US, duration=1, local_endpoint=FRONTEND,
+            tags={"env": "prod", "cluster": "c1", "other": "x"},
+        )
+        self.store(storage, [span])
+        tags = storage.autocomplete_tags()
+        assert sorted(tags.get_keys().execute()) == ["cluster", "env"]
+        assert tags.get_values("env").execute() == ["prod"]
+        assert tags.get_values("other").execute() == []

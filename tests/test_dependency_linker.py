@@ -1,0 +1,167 @@
+"""DependencyLinker edge-case matrix, mirroring DependencyLinkerTest.
+
+These cases are the spec the device linker (ops/linker.py) must match
+(SURVEY.md §4: "port these cases as the spec for the device linker").
+"""
+
+from tests.fixtures import BACKEND, DB, FRONTEND, TRACE
+from zipkin_tpu.internal.dependency_linker import DependencyLinker, link_traces
+from zipkin_tpu.model.span import DependencyLink, Endpoint, Span
+
+
+def links_of(*traces):
+    return sorted(link_traces(traces), key=lambda x: (x.parent, x.child))
+
+
+def _ep(name):
+    return Endpoint.create(name)
+
+
+class TestDependencyLinker:
+    def test_canonical_trace(self):
+        assert links_of(TRACE) == [
+            DependencyLink("backend", "mysql", 1, 1),
+            DependencyLink("frontend", "backend", 1, 0),
+        ]
+
+    def test_client_server_pair_links_once(self):
+        trace = [
+            Span.create("1", "a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create("1", "a", kind="SERVER", shared=True, local_endpoint=_ep("b")),
+        ]
+        assert links_of(trace) == [DependencyLink("a", "b", 1, 0)]
+
+    def test_uninstrumented_server_leaf_client(self):
+        trace = [
+            Span.create(
+                "1", "a", kind="CLIENT",
+                local_endpoint=_ep("a"), remote_endpoint=_ep("db"),
+            )
+        ]
+        assert links_of(trace) == [DependencyLink("a", "db", 1, 0)]
+
+    def test_uninstrumented_client_root_server(self):
+        trace = [
+            Span.create(
+                "1", "a", kind="SERVER",
+                local_endpoint=_ep("b"), remote_endpoint=_ep("mobile"),
+            )
+        ]
+        assert links_of(trace) == [DependencyLink("mobile", "b", 1, 0)]
+
+    def test_root_server_without_remote_has_no_link(self):
+        trace = [Span.create("1", "a", kind="SERVER", local_endpoint=_ep("b"))]
+        assert links_of(trace) == []
+
+    def test_separate_client_server_spans(self):
+        trace = [
+            Span.create("1", "a", kind="SERVER", local_endpoint=_ep("a")),
+            Span.create("1", "b", parent_id="a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create("1", "c", parent_id="b", kind="SERVER", local_endpoint=_ep("b")),
+        ]
+        assert links_of(trace) == [DependencyLink("a", "b", 1, 0)]
+
+    def test_local_spans_between_rpcs_are_transparent(self):
+        trace = [
+            Span.create("1", "a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create("1", "b", parent_id="a", local_endpoint=_ep("a"), name="local"),
+            Span.create("1", "c", parent_id="b", kind="SERVER", local_endpoint=_ep("b")),
+        ]
+        assert links_of(trace) == [DependencyLink("a", "b", 1, 0)]
+
+    def test_messaging_producer_broker_consumer(self):
+        trace = [
+            Span.create(
+                "1", "a", kind="PRODUCER",
+                local_endpoint=_ep("producer"), remote_endpoint=_ep("kafka"),
+            ),
+            Span.create(
+                "1", "b", parent_id="a", kind="CONSUMER", shared=True,
+                local_endpoint=_ep("consumer"), remote_endpoint=_ep("kafka"),
+            ),
+        ]
+        assert links_of(trace) == [
+            DependencyLink("kafka", "consumer", 1, 0),
+            DependencyLink("producer", "kafka", 1, 0),
+        ]
+
+    def test_messaging_without_broker_is_skipped(self):
+        trace = [Span.create("1", "a", kind="PRODUCER", local_endpoint=_ep("p"))]
+        assert links_of(trace) == []
+
+    def test_no_kind_with_both_sides_acts_like_client(self):
+        trace = [
+            Span.create(
+                "1", "a", local_endpoint=_ep("a"), remote_endpoint=_ep("b")
+            )
+        ]
+        assert links_of(trace) == [DependencyLink("a", "b", 1, 0)]
+
+    def test_no_kind_without_remote_is_skipped(self):
+        trace = [Span.create("1", "a", local_endpoint=_ep("a"))]
+        assert links_of(trace) == []
+
+    def test_error_counted_on_server_side(self):
+        trace = [
+            Span.create("1", "a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create(
+                "1", "a", kind="SERVER", shared=True,
+                local_endpoint=_ep("b"), tags={"error": "500"},
+            ),
+        ]
+        assert links_of(trace) == [DependencyLink("a", "b", 1, 1)]
+
+    def test_client_error_on_leaf_counted(self):
+        trace = [
+            Span.create(
+                "1", "a", kind="CLIENT", local_endpoint=_ep("a"),
+                remote_endpoint=_ep("db"), tags={"error": "timeout"},
+            )
+        ]
+        assert links_of(trace) == [DependencyLink("a", "db", 1, 1)]
+
+    def test_loopback(self):
+        trace = [
+            Span.create("1", "a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create("1", "a", kind="SERVER", shared=True, local_endpoint=_ep("a")),
+        ]
+        assert links_of(trace) == [DependencyLink("a", "a", 1, 0)]
+
+    def test_missing_local_service_name_skipped(self):
+        trace = [
+            Span.create("1", "a", kind="CLIENT", remote_endpoint=_ep("b"))
+        ]
+        # client with no local name: parent unknown -> no link
+        assert links_of(trace) == []
+
+    def test_call_counts_accumulate_across_traces(self):
+        t1 = [
+            Span.create(
+                "1", "a", kind="CLIENT",
+                local_endpoint=_ep("a"), remote_endpoint=_ep("b"),
+            )
+        ]
+        t2 = [
+            Span.create(
+                "2", "a", kind="CLIENT",
+                local_endpoint=_ep("a"), remote_endpoint=_ep("b"),
+            )
+        ]
+        assert links_of(t1, t2) == [DependencyLink("a", "b", 2, 0)]
+
+    def test_put_links_merges_preaggregated(self):
+        linker = DependencyLinker()
+        linker.put_links([DependencyLink("a", "b", 2, 1)])
+        linker.put_links([DependencyLink("a", "b", 3, 0)])
+        assert linker.link() == [DependencyLink("a", "b", 5, 1)]
+
+    def test_dangling_server_span_uses_remote(self):
+        # server span whose parent was never reported: ca remote still links
+        trace = [
+            Span.create("1", "a", kind="SERVER", local_endpoint=_ep("root")),
+            Span.create(
+                "1", "c", parent_id="fefe", kind="SERVER",
+                local_endpoint=_ep("b"), remote_endpoint=_ep("a"),
+            ),
+        ]
+        assert links_of(trace) == [DependencyLink("a", "b", 1, 0)]

@@ -1,0 +1,37 @@
+"""The in-memory oracle passes the storage contract, plus oracle-specific
+behaviors (eviction bound)."""
+
+from tests.fixtures import FRONTEND, TODAY_US
+from tests.storage_contract import StorageContract
+from zipkin_tpu.model.span import Span
+from zipkin_tpu.storage.memory import InMemoryStorage
+
+
+class TestInMemoryStorage(StorageContract):
+    def make_storage(self, **kwargs):
+        return InMemoryStorage(**kwargs)
+
+    def test_eviction_drops_oldest_traces_whole(self):
+        storage = InMemoryStorage(max_span_count=6)
+        for i in range(5):
+            spans = [
+                Span.create(
+                    f"{i + 1:x}", f"{j + 1:x}", name="op",
+                    timestamp=TODAY_US + i * 1_000_000 + j,
+                    duration=1, local_endpoint=FRONTEND,
+                )
+                for j in range(2)
+            ]
+            storage.span_consumer().accept(spans).execute()
+        assert storage.span_count <= 6
+        # newest traces survive
+        assert storage.span_store().get_trace("5").execute() != []
+        assert storage.span_store().get_trace("1").execute() == []
+
+    def test_clear(self):
+        storage = InMemoryStorage()
+        storage.span_consumer().accept(
+            [Span.create("1", "2", timestamp=TODAY_US)]
+        ).execute()
+        storage.clear()
+        assert storage.span_count == 0

@@ -1,0 +1,138 @@
+"""Model normalization contract, mirroring SpanTest/EndpointTest upstream."""
+
+import pytest
+
+from zipkin_tpu.model.span import (
+    Annotation,
+    DependencyLink,
+    Endpoint,
+    Kind,
+    Span,
+    merge_links,
+    merge_spans,
+)
+
+
+class TestIds:
+    def test_trace_id_pads_to_16(self):
+        assert Span.create("1234", "1").trace_id == "0000000000001234"
+
+    def test_long_trace_id_pads_to_32(self):
+        s = Span.create("48485a3953bb6124" + "1234", "1")
+        assert len(s.trace_id) == 32
+        assert s.trace_id == "000000000000" + "48485a3953bb61241234"
+
+    def test_trace_id_lowercased(self):
+        assert Span.create("48485A3953BB6124", "1").trace_id == "48485a3953bb6124"
+
+    def test_trace_id_low64(self):
+        s = Span.create("463ac35c9f6413ad48485a3953bb6124", "1")
+        assert s.trace_id_low64 == 0x48485A3953BB6124
+
+    @pytest.mark.parametrize("bad", ["", "g", "x" * 16, "a" * 33, "0" * 32])
+    def test_invalid_trace_id_raises(self, bad):
+        with pytest.raises(ValueError):
+            Span.create(bad, "1")
+
+    def test_span_id_pads(self):
+        assert Span.create("1", "2a").id == "000000000000002a"
+
+    def test_all_zero_span_id_raises(self):
+        with pytest.raises(ValueError):
+            Span.create("1", "0")
+
+    def test_all_zero_parent_is_none(self):
+        assert Span.create("1", "2", parent_id="0000000000000000").parent_id is None
+        assert Span.create("1", "2", parent_id="").parent_id is None
+
+
+class TestNormalization:
+    def test_name_lowercased_and_empty_is_none(self):
+        assert Span.create("1", "2", name="GET /Api").name == "get /api"
+        assert Span.create("1", "2", name="").name is None
+
+    def test_kind_parses_from_string(self):
+        assert Span.create("1", "2", kind="client").kind is Kind.CLIENT
+        with pytest.raises(ValueError):
+            Span.create("1", "2", kind="bogus")
+
+    def test_zero_timestamp_duration_become_none(self):
+        s = Span.create("1", "2", timestamp=0, duration=0)
+        assert s.timestamp is None and s.duration is None
+        assert s.timestamp_as_long() == 0 and s.duration_as_long() == 0
+
+    def test_annotations_sorted_and_deduped(self):
+        s = Span.create(
+            "1", "2", annotations=[(2, "b"), (1, "a"), (2, "b"), (1, "z")]
+        )
+        assert s.annotations == (
+            Annotation(1, "a"),
+            Annotation(1, "z"),
+            Annotation(2, "b"),
+        )
+
+    def test_error_tag_presence_is_error(self):
+        assert Span.create("1", "2", tags={"error": ""}).is_error
+        assert not Span.create("1", "2", tags={"status": "500"}).is_error
+
+    def test_false_flags_become_none(self):
+        s = Span.create("1", "2", debug=False, shared=False)
+        assert s.debug is None and s.shared is None
+
+
+class TestEndpoint:
+    def test_service_name_lowercased(self):
+        assert Endpoint.create("FavStar").service_name == "favstar"
+
+    def test_all_empty_is_none(self):
+        assert Endpoint.create(None, None, None) is None
+        assert Endpoint.create("", "", 0) is None
+
+    def test_ip_routes_by_family(self):
+        ep = Endpoint.create("x", "192.168.1.1")
+        assert ep.ipv4 == "192.168.1.1" and ep.ipv6 is None
+        ep = Endpoint.create("x", "2001:db8::1")
+        assert ep.ipv6 == "2001:db8::1" and ep.ipv4 is None
+
+    def test_mapped_ipv4_stored_as_ipv4(self):
+        ep = Endpoint.create("x", "::ffff:192.168.1.1")
+        assert ep.ipv4 == "192.168.1.1" and ep.ipv6 is None
+
+    def test_unparseable_ip_dropped(self):
+        assert Endpoint.create("x", "not-an-ip").ipv4 is None
+
+    def test_port_zero_is_none_and_range_checked(self):
+        assert Endpoint.create("x", None, 0).port is None
+        with pytest.raises(ValueError):
+            Endpoint.create("x", None, 65536)
+
+
+class TestMerge:
+    def test_merge_unions_fields(self):
+        a = Span.create("1", "2", name="get", timestamp=5, tags={"k": "v"})
+        b = Span.create("1", "2", kind="CLIENT", timestamp=3, duration=7,
+                        tags={"k": "ignored", "k2": "v2"})
+        m = merge_spans(a, b)
+        assert m.name == "get"
+        assert m.kind is Kind.CLIENT
+        assert m.timestamp == 3 and m.duration == 7
+        assert m.tags == {"k": "v", "k2": "v2"}
+
+    def test_merge_requires_same_key(self):
+        a = Span.create("1", "2")
+        b = Span.create("1", "2", shared=True)
+        with pytest.raises(ValueError):
+            merge_spans(a, b)
+
+    def test_merge_links_sums(self):
+        merged = merge_links(
+            [
+                DependencyLink("a", "b", 1, 0),
+                DependencyLink("a", "b", 2, 1),
+                DependencyLink("a", "c", 1, 0),
+            ]
+        )
+        assert merged == (
+            DependencyLink("a", "b", 3, 1),
+            DependencyLink("a", "c", 1, 0),
+        )

@@ -1,0 +1,111 @@
+"""SpanNode tree assembly edge cases, mirroring SpanNodeTest upstream."""
+
+from tests.fixtures import TRACE
+from zipkin_tpu.internal.span_node import build_tree, merge_trace
+from zipkin_tpu.model.span import Endpoint, Span
+
+
+def ids(node):
+    return node.span.id if node.span else None
+
+
+class TestBuildTree:
+    def test_empty_is_none(self):
+        assert build_tree([]) is None
+
+    def test_single_span(self):
+        root = build_tree([Span.create("1", "a")])
+        assert ids(root) == "000000000000000a" and not root.children
+
+    def test_parent_child(self):
+        spans = [Span.create("1", "a"), Span.create("1", "b", parent_id="a")]
+        root = build_tree(spans)
+        assert ids(root) == "000000000000000a"
+        assert [ids(c) for c in root.children] == ["000000000000000b"]
+
+    def test_shared_span_parents_under_client_half(self):
+        client = Span.create("1", "b", parent_id="a", kind="CLIENT")
+        server = Span.create("1", "b", parent_id="a", kind="SERVER", shared=True)
+        root_span = Span.create("1", "a", kind="SERVER")
+        root = build_tree([root_span, client, server])
+        assert ids(root) == "000000000000000a"
+        (child,) = root.children
+        assert child.span is client
+        (grandchild,) = child.children
+        assert grandchild.span is server
+
+    def test_child_of_shared_span_attaches_below_server_half(self):
+        # downstream instrumentation references the shared id as parent,
+        # and the client half of that id was never reported
+        server = Span.create("1", "b", parent_id="a", kind="SERVER", shared=True)
+        downstream = Span.create("1", "c", parent_id="b", kind="CLIENT")
+        root_span = Span.create("1", "a", kind="SERVER")
+        root = build_tree([root_span, server, downstream])
+        # b has no client half; it dangles under synthetic or attaches via parent a
+        found = {ids(n): [ids(c) for c in n.children] for n in root.traverse()}
+        assert "000000000000000c" in found["000000000000000b"]
+
+    def test_missing_parent_dangles_under_synthetic_root(self):
+        spans = [
+            Span.create("1", "a"),
+            Span.create("1", "c", parent_id="fefe"),  # parent never reported
+        ]
+        root = build_tree(spans)
+        assert root.is_synthetic_root
+        assert sorted(filter(None, (ids(c) for c in root.children))) == [
+            "000000000000000a",
+            "000000000000000c",
+        ]
+
+    def test_multiple_roots_adopted(self):
+        spans = [Span.create("1", "a"), Span.create("1", "b")]
+        root = build_tree(spans)
+        assert root.is_synthetic_root and len(root.children) == 2
+
+    def test_traverse_is_breadth_first(self):
+        spans = [
+            Span.create("1", "a"),
+            Span.create("1", "b", parent_id="a"),
+            Span.create("1", "c", parent_id="a"),
+            Span.create("1", "d", parent_id="b"),
+        ]
+        order = [ids(n) for n in build_tree(spans).traverse()]
+        assert order.index("000000000000000d") == 3
+
+    def test_duplicate_reports_merged(self):
+        spans = [
+            Span.create("1", "a", name="get"),
+            Span.create("1", "a", duration=10),
+        ]
+        root = build_tree(spans)
+        assert root.span.name == "get" and root.span.duration == 10
+        assert not root.children
+
+
+class TestMergeTrace:
+    def test_dedups_and_sorts(self):
+        dup = TRACE + [TRACE[1]]
+        merged = merge_trace(dup)
+        assert len(merged) == len(TRACE)
+        timestamps = [s.timestamp for s in merged]
+        assert timestamps == sorted(timestamps)
+
+    def test_client_and_shared_server_stay_distinct(self):
+        merged = merge_trace(TRACE)
+        same_id = [s for s in merged if s.id == "0000000000000002"]
+        assert len(same_id) == 2
+        assert {bool(s.shared) for s in same_id} == {True, False}
+
+
+class TestReviewRegressions:
+    def test_same_id_different_services_without_shared_flag(self):
+        # v2 instrumentation that forgot the shared flag: same id, two services
+        spans = [
+            Span.create("1", "a", kind="CLIENT",
+                        local_endpoint=Endpoint.create("front")),
+            Span.create("1", "a", kind="SERVER",
+                        local_endpoint=Endpoint.create("back")),
+        ]
+        root = build_tree(spans)  # must not raise
+        assert root is not None
+        assert len(list(root.traverse())) == 2

@@ -1,0 +1,32 @@
+"""zipkin-tpu: a TPU-native distributed-tracing backend.
+
+A ground-up rebuild of the capabilities of Zipkin (reference:
+``llinder/zipkin``, a fork of ``openzipkin/zipkin``) designed TPU-first:
+
+- host tier: span model, codecs, collectors, Zipkin v2 HTTP API (aiohttp),
+  an exact in-memory storage oracle;
+- device tier: columnar span batches streamed into JAX arrays; per-(service,
+  spanName) latency t-digests, HyperLogLog cardinalities, and service
+  dependency-link counts maintained as sharded device state updated by
+  jit-compiled ingest steps and merged across chips with ``lax.psum``.
+
+Layering mirrors the reference (see SURVEY.md §1):
+
+- L0 model/codecs    -> :mod:`zipkin_tpu.model`
+- L1 storage SPI     -> :mod:`zipkin_tpu.storage.spi`, oracle in
+                        :mod:`zipkin_tpu.storage.memory`
+- L2 TPU backend     -> :mod:`zipkin_tpu.storage.tpu` (+ :mod:`zipkin_tpu.ops`)
+- L3 collectors      -> :mod:`zipkin_tpu.collector`
+- L4 server          -> :mod:`zipkin_tpu.server`
+- L6 test kit        -> :mod:`zipkin_tpu.testkit`
+"""
+
+__version__ = "0.1.0"
+
+from zipkin_tpu.model.span import (  # noqa: F401
+    Annotation,
+    DependencyLink,
+    Endpoint,
+    Kind,
+    Span,
+)

@@ -1,0 +1,1 @@
+"""Internal helpers: hex/id codecs, trace reassembly, dependency linking."""

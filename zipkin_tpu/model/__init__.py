@@ -1,0 +1,9 @@
+"""L0: the span model and wire codecs (JSON v2/v1, proto3, thrift)."""
+
+from zipkin_tpu.model.span import (  # noqa: F401
+    Annotation,
+    DependencyLink,
+    Endpoint,
+    Kind,
+    Span,
+)

@@ -1,0 +1,89 @@
+"""Codec dispatch: wire-format auto-detection and the encoder/decoder enums.
+
+Reference semantics: ``zipkin2/codec/SpanBytesDecoder.java`` /
+``SpanBytesEncoder.java`` and the first-byte sniffing in
+``ZipkinHttpCollector`` (SURVEY.md §3.2): ``[`` begins JSON (v1 or v2
+distinguished by content), ``0x0a`` a proto3 ``ListOfSpans`` (field 1,
+length-delimited), ``0x0c`` a thrift struct-list.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Callable, List, Sequence
+
+from zipkin_tpu.model import json_v1, json_v2, proto3, thrift
+from zipkin_tpu.model.span import Span
+
+
+class Encoding(enum.Enum):
+    JSON_V2 = "json_v2"
+    JSON_V1 = "json_v1"
+    PROTO3 = "proto3"
+    THRIFT = "thrift"
+
+    @property
+    def media_type(self) -> str:
+        return {
+            Encoding.JSON_V2: "application/json",
+            Encoding.JSON_V1: "application/json",
+            Encoding.PROTO3: "application/x-protobuf",
+            Encoding.THRIFT: "application/x-thrift",
+        }[self]
+
+
+_DECODERS: dict = {
+    Encoding.JSON_V2: json_v2.decode_span_list,
+    Encoding.JSON_V1: json_v1.decode_v1_span_list,
+    Encoding.PROTO3: proto3.decode_span_list,
+    Encoding.THRIFT: thrift.decode_span_list,
+}
+
+_ENCODERS: dict = {
+    Encoding.JSON_V2: json_v2.encode_span_list,
+    Encoding.JSON_V1: json_v1.encode_v1_span_list,
+    Encoding.PROTO3: proto3.encode_span_list,
+}
+
+
+def _looks_like_v1_json(data: bytes) -> bool:
+    """v1 JSON is distinguished by binaryAnnotations or endpoint'd annotations."""
+    if b'"binaryAnnotations"' in data:
+        return True
+    # annotations with an "endpoint" member only exist in v1
+    if b'"annotations"' in data and b'"endpoint"' in data:
+        return True
+    return False
+
+
+def detect(data: bytes) -> Encoding:
+    """Sniff the encoding of an ingest payload from its first byte(s)."""
+    if not data:
+        raise ValueError("empty payload")
+    first = data[0]
+    if first in (0x5B, 0x7B) or (first in (0x20, 0x09, 0x0D) and b"[" in data[:64]):
+        return Encoding.JSON_V1 if _looks_like_v1_json(data) else Encoding.JSON_V2
+    if first == 0x0A:
+        return Encoding.PROTO3
+    if first == 0x0C:
+        return Encoding.THRIFT
+    raise ValueError(f"unrecognized span payload (first byte 0x{first:02x})")
+
+
+def decode_spans(data: bytes, encoding: Encoding | None = None) -> List[Span]:
+    """Decode an ingest payload to v2 spans, sniffing the format if needed."""
+    enc = encoding or detect(data)
+    decoder: Callable[[bytes], List[Span]] = _DECODERS[enc]
+    return decoder(data)
+
+
+def encode_spans(spans: Sequence[Span], encoding: Encoding = Encoding.JSON_V2) -> bytes:
+    encoder = _ENCODERS.get(encoding)
+    if encoder is None:
+        raise ValueError(f"encoding {encoding} does not support span encode")
+    return encoder(spans)
+
+
+def pretty_json(data: bytes) -> str:  # pragma: no cover - debug aid
+    return json.dumps(json.loads(data), indent=2)

@@ -1,0 +1,261 @@
+"""Legacy thrift (TBinaryProtocol) codec for v1 spans — the Scribe path.
+
+Reference semantics: ``zipkin2/internal/ThriftCodec.java`` (SURVEY.md §2.1).
+Decodes a thrift list of v1 Span structs (the payload Scribe delivered
+base64-encoded) into v2 spans via :mod:`zipkin_tpu.model.json_v1`'s
+converter. Struct schema (zipkinCore.thrift):
+
+- Span: 1:i64 trace_id, 3:string name, 4:i64 id, 5:i64 parent_id,
+  6:list<Annotation> annotations, 8:list<BinaryAnnotation> binary_annotations,
+  9:bool debug, 10:i64 timestamp, 11:i64 duration, 12:i64 trace_id_high
+- Annotation: 1:i64 timestamp, 2:string value, 3:Endpoint host
+- BinaryAnnotation: 1:string key, 2:binary value, 3:i32 annotation_type,
+  4:Endpoint host  (types: 0=BOOL, 6=STRING; others stringified)
+- Endpoint: 1:i32 ipv4, 2:i16 port, 3:string service_name, 4:binary ipv6
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import struct
+from typing import List, Optional, Tuple
+
+from zipkin_tpu.internal.hex import to_lower_hex
+from zipkin_tpu.model.json_v1 import (
+    V1Annotation,
+    V1BinaryAnnotation,
+    V1Span,
+    convert_v1_spans,
+)
+from zipkin_tpu.model.span import Endpoint, Span
+
+_T_STOP = 0
+_T_BOOL = 2
+_T_BYTE = 3
+_T_DOUBLE = 4
+_T_I16 = 6
+_T_I32 = 8
+_T_I64 = 10
+_T_STRING = 11
+_T_STRUCT = 12
+_T_MAP = 13
+_T_SET = 14
+_T_LIST = 15
+
+
+class _Reader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes, pos: int = 0) -> None:
+        self.data = data
+        self.pos = pos
+
+    def u8(self) -> int:
+        v = self.data[self.pos]
+        self.pos += 1
+        return v
+
+    def i16(self) -> int:
+        (v,) = struct.unpack_from(">h", self.data, self.pos)
+        self.pos += 2
+        return v
+
+    def i32(self) -> int:
+        (v,) = struct.unpack_from(">i", self.data, self.pos)
+        self.pos += 4
+        return v
+
+    def i64(self) -> int:
+        (v,) = struct.unpack_from(">q", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def binary(self) -> bytes:
+        n = self.i32()
+        if n < 0 or self.pos + n > len(self.data):
+            raise ValueError("truncated thrift binary")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def skip(self, ttype: int) -> None:
+        if ttype in (_T_BOOL, _T_BYTE):
+            self.pos += 1
+        elif ttype == _T_I16:
+            self.pos += 2
+        elif ttype == _T_I32:
+            self.pos += 4
+        elif ttype in (_T_I64, _T_DOUBLE):
+            self.pos += 8
+        elif ttype == _T_STRING:
+            self.binary()
+        elif ttype == _T_STRUCT:
+            while True:
+                ft = self.u8()
+                if ft == _T_STOP:
+                    return
+                self.i16()
+                self.skip(ft)
+        elif ttype in (_T_LIST, _T_SET):
+            et = self.u8()
+            for _ in range(self.i32()):
+                self.skip(et)
+        elif ttype == _T_MAP:
+            kt, vt = self.u8(), self.u8()
+            for _ in range(self.i32()):
+                self.skip(kt)
+                self.skip(vt)
+        else:
+            raise ValueError(f"unknown thrift type {ttype}")
+
+
+def _read_endpoint(r: _Reader) -> Optional[Endpoint]:
+    ipv4 = None
+    port = None
+    service = None
+    ipv6 = None
+    while True:
+        ftype = r.u8()
+        if ftype == _T_STOP:
+            break
+        fid = r.i16()
+        if fid == 1 and ftype == _T_I32:
+            raw = r.i32() & 0xFFFFFFFF
+            ipv4 = str(ipaddress.IPv4Address(raw)) if raw else None
+        elif fid == 2 and ftype == _T_I16:
+            port = r.i16() & 0xFFFF
+        elif fid == 3 and ftype == _T_STRING:
+            service = r.binary().decode(errors="replace")
+        elif fid == 4 and ftype == _T_STRING:
+            raw = r.binary()
+            ipv6 = str(ipaddress.IPv6Address(raw)) if len(raw) == 16 else None
+        else:
+            r.skip(ftype)
+    return Endpoint.create(service_name=service, ipv4=ipv4, ipv6=ipv6, port=port)
+
+
+def _read_annotation(r: _Reader) -> Tuple[Optional[V1Annotation], None]:
+    ts = 0
+    value = ""
+    host = None
+    while True:
+        ftype = r.u8()
+        if ftype == _T_STOP:
+            break
+        fid = r.i16()
+        if fid == 1 and ftype == _T_I64:
+            ts = r.i64()
+        elif fid == 2 and ftype == _T_STRING:
+            value = r.binary().decode(errors="replace")
+        elif fid == 3 and ftype == _T_STRUCT:
+            host = _read_endpoint(r)
+        else:
+            r.skip(ftype)
+    if ts <= 0 or not value:
+        return None, None
+    return V1Annotation(ts, value, host), None
+
+
+_TYPE_BOOL = 0
+_TYPE_STRING = 6
+
+
+def _read_binary_annotation(r: _Reader) -> Optional[V1BinaryAnnotation]:
+    key = None
+    raw: bytes = b""
+    ann_type = _TYPE_STRING
+    host = None
+    while True:
+        ftype = r.u8()
+        if ftype == _T_STOP:
+            break
+        fid = r.i16()
+        if fid == 1 and ftype == _T_STRING:
+            key = r.binary().decode(errors="replace")
+        elif fid == 2 and ftype == _T_STRING:
+            raw = r.binary()
+        elif fid == 3 and ftype == _T_I32:
+            ann_type = r.i32()
+        elif fid == 4 and ftype == _T_STRUCT:
+            host = _read_endpoint(r)
+        else:
+            r.skip(ftype)
+    if key is None:
+        return None
+    if ann_type == _TYPE_BOOL:
+        return V1BinaryAnnotation(key, raw == b"\x01" or raw == b"\x00\x01" or bool(raw and raw[-1]), host)
+    return V1BinaryAnnotation(key, raw.decode(errors="replace"), host)
+
+
+def _read_v1_span(r: _Reader) -> V1Span:
+    trace_id = 0
+    trace_id_high = 0
+    span_id = 0
+    parent_id = 0
+    name = None
+    annotations: List[V1Annotation] = []
+    binary: List[V1BinaryAnnotation] = []
+    debug = None
+    timestamp = None
+    duration = None
+    while True:
+        ftype = r.u8()
+        if ftype == _T_STOP:
+            break
+        fid = r.i16()
+        if fid == 1 and ftype == _T_I64:
+            trace_id = r.i64()
+        elif fid == 3 and ftype == _T_STRING:
+            name = r.binary().decode(errors="replace")
+        elif fid == 4 and ftype == _T_I64:
+            span_id = r.i64()
+        elif fid == 5 and ftype == _T_I64:
+            parent_id = r.i64()
+        elif fid == 6 and ftype == _T_LIST:
+            r.u8()  # element type (struct)
+            for _ in range(r.i32()):
+                ann, _ = _read_annotation(r)
+                if ann is not None:
+                    annotations.append(ann)
+        elif fid == 8 and ftype == _T_LIST:
+            r.u8()
+            for _ in range(r.i32()):
+                b = _read_binary_annotation(r)
+                if b is not None:
+                    binary.append(b)
+        elif fid == 9 and ftype == _T_BOOL:
+            debug = bool(r.u8())
+        elif fid == 10 and ftype == _T_I64:
+            timestamp = r.i64()
+        elif fid == 11 and ftype == _T_I64:
+            duration = r.i64()
+        elif fid == 12 and ftype == _T_I64:
+            trace_id_high = r.i64()
+        else:
+            r.skip(ftype)
+    if trace_id_high:
+        tid = to_lower_hex(trace_id_high) + to_lower_hex(trace_id)
+    else:
+        tid = to_lower_hex(trace_id)
+    return V1Span(
+        trace_id=tid,
+        id=to_lower_hex(span_id),
+        parent_id=to_lower_hex(parent_id) if parent_id else None,
+        name=name,
+        timestamp=timestamp,
+        duration=duration,
+        annotations=tuple(annotations),
+        binary_annotations=tuple(binary),
+        debug=debug,
+    )
+
+
+def decode_span_list(data: bytes) -> List[Span]:
+    """Decode a thrift list<Span> (first byte 0x0c = T_STRUCT element type)."""
+    r = _Reader(data)
+    etype = r.u8()
+    if etype != _T_STRUCT:
+        raise ValueError("expected thrift list of structs")
+    count = r.i32()
+    v1_spans = [_read_v1_span(r) for _ in range(count)]
+    return convert_v1_spans(v1_spans)

@@ -1,0 +1,11 @@
+"""L1/L2: the storage SPI, the in-memory oracle, and the TPU-backed store."""
+
+from zipkin_tpu.storage.spi import (  # noqa: F401
+    AutocompleteTags,
+    QueryRequest,
+    ServiceAndSpanNames,
+    SpanConsumer,
+    SpanStore,
+    StorageComponent,
+    Traces,
+)

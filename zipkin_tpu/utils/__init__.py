@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: component lifecycle, the Call seam, metrics."""
