@@ -290,3 +290,9 @@ class TestReviewRegressions:
     def test_decode_missing_id_is_value_error(self):
         with pytest.raises(ValueError):
             json_v2.decode_span_list(b'[{"traceId":"abc"}]')
+
+    def test_leading_newline_json_still_detected(self):
+        data = b'\n  [{"traceId":"a","id":"b"}]\n'
+        assert codec.detect(data) is Encoding.JSON_V2
+        (s,) = codec.decode_spans(data)
+        assert s.trace_id == "000000000000000a"

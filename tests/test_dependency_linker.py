@@ -165,3 +165,18 @@ class TestDependencyLinker:
             ),
         ]
         assert links_of(trace) == [DependencyLink("a", "b", 1, 0)]
+
+    def test_backfill_link_to_client_in_different_service(self):
+        # server(a) -> client(b -> c): the b-side server span was never
+        # reported, so a->b is backfilled alongside b->c (rule 6b)
+        trace = [
+            Span.create("1", "a", kind="SERVER", local_endpoint=_ep("a")),
+            Span.create(
+                "1", "b", parent_id="a", kind="CLIENT",
+                local_endpoint=_ep("b"), remote_endpoint=_ep("c"),
+            ),
+        ]
+        assert links_of(trace) == [
+            DependencyLink("a", "b", 1, 0),
+            DependencyLink("b", "c", 1, 0),
+        ]

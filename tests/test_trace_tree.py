@@ -109,3 +109,17 @@ class TestReviewRegressions:
         root = build_tree(spans)  # must not raise
         assert root is not None
         assert len(list(root.traverse())) == 2
+
+    def test_lenient_mode_unifies_trace_id_renditions(self):
+        long_form = Span.create(
+            "463ac35c9f6413ad48485a3953bb6124", "a", name="get",
+            local_endpoint=Endpoint.create("svc"),
+        )
+        short_form = Span.create(
+            "48485a3953bb6124", "a", duration=10,
+            local_endpoint=Endpoint.create("svc"),
+        )
+        merged = merge_trace([long_form, short_form])
+        assert len(merged) == 1
+        assert merged[0].trace_id == "463ac35c9f6413ad48485a3953bb6124"
+        assert merged[0].name == "get" and merged[0].duration == 10

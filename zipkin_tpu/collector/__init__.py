@@ -1,0 +1,9 @@
+"""L3: the collector framework — decode, sample, count, hand to storage."""
+
+from zipkin_tpu.collector.core import (  # noqa: F401
+    Collector,
+    CollectorComponent,
+    CollectorMetrics,
+    CollectorSampler,
+    InMemoryCollectorMetrics,
+)

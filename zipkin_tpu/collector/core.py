@@ -1,0 +1,193 @@
+"""Collector core: the decode -> sample -> store pipeline every transport uses.
+
+Reference semantics: ``zipkin2/collector/Collector.java``,
+``CollectorComponent.java``, ``CollectorSampler.java``,
+``CollectorMetrics.java``, ``InMemoryCollectorMetrics.java`` (SURVEY.md
+§2.2, §3.2). The counter taxonomy (messages, messagesDropped, bytes, spans,
+spansDropped) is kept name-for-name so dashboards translate.
+
+Sampling is **boundary sampling**: the decision is a pure function of the
+trace id's low 64 bits, so every collector node makes the same call for
+every span of a trace without coordination — the property that lets the
+ingest tier scale out statelessly (and lets the TPU ingest shard by trace
+id without resampling).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from zipkin_tpu.model import codec
+from zipkin_tpu.model.span import Span
+from zipkin_tpu.storage.spi import StorageComponent
+from zipkin_tpu.utils.component import Component
+
+logger = logging.getLogger(__name__)
+
+_MAX_I64 = (1 << 63) - 1
+
+
+class CollectorSampler:
+    """Samples traces at a fixed rate keyed on trace-id low-64 bits.
+
+    ``is_sampled`` compares ``abs(signed_low64(traceId))`` against
+    ``rate * 2^63`` — the same arithmetic as the reference, so a mixed
+    fleet of reference and rebuild collectors samples identically.
+    Debug spans always pass.
+    """
+
+    def __init__(self, rate: float = 1.0) -> None:
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"rate should be between 0 and 1: {rate}")
+        self.rate = rate
+        self._boundary = int(_MAX_I64 * rate)
+
+    def is_sampled(self, trace_id_low64: int, debug: bool = False) -> bool:
+        if debug:
+            return True
+        signed = trace_id_low64 - (1 << 64) if trace_id_low64 >= (1 << 63) else trace_id_low64
+        t = abs(signed)
+        return t <= self._boundary
+
+    def test(self, span: Span) -> bool:
+        return self.is_sampled(span.trace_id_low64, bool(span.debug))
+
+
+class CollectorMetrics:
+    """Counter hooks; subclass or use :class:`InMemoryCollectorMetrics`."""
+
+    def increment_messages(self) -> None: ...
+
+    def increment_messages_dropped(self) -> None: ...
+
+    def increment_bytes(self, quantity: int) -> None: ...
+
+    def increment_spans(self, quantity: int) -> None: ...
+
+    def increment_spans_dropped(self, quantity: int) -> None: ...
+
+    def for_transport(self, transport: str) -> "CollectorMetrics":
+        return self
+
+
+class InMemoryCollectorMetrics(CollectorMetrics):
+    """Thread-safe counters, partitionable per transport.
+
+    Reference: ``InMemoryCollectorMetrics.java``.
+    """
+
+    def __init__(self, transport: Optional[str] = None, _counters: Optional[Dict[str, int]] = None) -> None:
+        self.transport = transport
+        self._counters: Dict[str, int] = _counters if _counters is not None else {}
+        self._lock = threading.Lock()
+
+    def _inc(self, name: str, by: int = 1) -> None:
+        key = f"{self.transport}.{name}" if self.transport else name
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def increment_messages(self) -> None:
+        self._inc("messages")
+
+    def increment_messages_dropped(self) -> None:
+        self._inc("messages_dropped")
+
+    def increment_bytes(self, quantity: int) -> None:
+        self._inc("bytes", quantity)
+
+    def increment_spans(self, quantity: int) -> None:
+        self._inc("spans", quantity)
+
+    def increment_spans_dropped(self, quantity: int) -> None:
+        self._inc("spans_dropped", quantity)
+
+    def for_transport(self, transport: str) -> "InMemoryCollectorMetrics":
+        child = InMemoryCollectorMetrics(transport, self._counters)
+        child._lock = self._lock
+        return child
+
+    def get(self, name: str, transport: Optional[str] = None) -> int:
+        key = f"{transport}.{name}" if transport else name
+        with self._lock:
+            return self._counters.get(key, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+
+class Collector:
+    """The shared ingest pipeline: bytes or spans in, storage writes out.
+
+    Reference: ``Collector.java#acceptSpans``. Errors while storing are
+    counted as dropped spans and logged, never raised to the transport —
+    at-least-once transports redeliver, lossy ones move on.
+    """
+
+    def __init__(
+        self,
+        storage: StorageComponent,
+        *,
+        sampler: Optional[CollectorSampler] = None,
+        metrics: Optional[CollectorMetrics] = None,
+    ) -> None:
+        self.storage = storage
+        self.sampler = sampler or CollectorSampler(1.0)
+        self.metrics = metrics or CollectorMetrics()
+        self._consumer = storage.span_consumer()
+
+    def accept_spans_bytes(
+        self, data: bytes, encoding: Optional[codec.Encoding] = None
+    ) -> int:
+        """Decode one transport message and ingest it.
+
+        Returns the number of spans accepted (post-sampling). Raises
+        ``ValueError`` on malformed payloads (the transport decides whether
+        that is an HTTP 400 or a poison-pill skip) — after counting the
+        dropped message.
+        """
+        self.metrics.increment_messages()
+        self.metrics.increment_bytes(len(data))
+        try:
+            spans = codec.decode_spans(data, encoding)
+        except Exception as e:
+            self.metrics.increment_messages_dropped()
+            raise ValueError(f"cannot decode spans: {e}") from e
+        return self.accept(spans)
+
+    def accept(self, spans: Sequence[Span]) -> int:
+        """Sample + store already-decoded spans; returns count accepted."""
+        if not spans:
+            return 0
+        self.metrics.increment_spans(len(spans))
+        sampled: List[Span] = [s for s in spans if self.sampler.test(s)]
+        dropped = len(spans) - len(sampled)
+        if dropped:
+            self.metrics.increment_spans_dropped(dropped)
+        if not sampled:
+            return 0
+        try:
+            self._consumer.accept(sampled).execute()
+        except Exception:
+            logger.exception("cannot store %d spans", len(sampled))
+            self.metrics.increment_spans_dropped(len(sampled))
+            return 0
+        return len(sampled)
+
+
+@dataclasses.dataclass
+class CollectorComponent(Component):
+    """Lifecycle contract for transports (start/check/close).
+
+    Reference: ``CollectorComponent.java``. Concrete transports:
+    HTTP (in the server), gRPC, and the queue consumers in
+    :mod:`zipkin_tpu.collector.transports`.
+    """
+
+    collector: Collector
+
+    def start(self) -> "CollectorComponent":
+        return self

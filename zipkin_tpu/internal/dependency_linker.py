@@ -20,7 +20,9 @@ Linking rules (breadth-first over the reassembled tree):
 6. For RPC spans, the nearest ancestor with a kind (the "RPC ancestor")
    resolves the parent: a SERVER span prefers its instrumented tree caller
    over its own ``ca`` address annotation; a CLIENT span missing a local
-   service name inherits the ancestor's.
+   service name inherits the ancestor's. A CLIENT span whose service
+   *differs* from its RPC ancestor's implies an uninstrumented hop between
+   them, and that ancestor->client link is backfilled (with no error).
 7. An error is counted when the contributing span has an ``error`` tag.
 """
 
@@ -84,8 +86,18 @@ class DependencyLinker:
             rpc_ancestor = _find_rpc_ancestor(node)
             if rpc_ancestor is not None:
                 ancestor_name = rpc_ancestor.local_service_name
-                if ancestor_name is not None and (kind is Kind.SERVER or parent is None):
-                    parent = ancestor_name
+                if ancestor_name is not None:
+                    # Rule 6b: a CLIENT span whose service differs from its
+                    # RPC ancestor's implies an uninstrumented hop between
+                    # them — backfill that link (error unknown, so none).
+                    if (
+                        kind is Kind.CLIENT
+                        and local is not None
+                        and ancestor_name != local
+                    ):
+                        self._add(ancestor_name, local, False)
+                    if kind is Kind.SERVER or parent is None:
+                        parent = ancestor_name
 
             if parent is None or child is None:
                 continue

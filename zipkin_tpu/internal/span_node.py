@@ -10,6 +10,8 @@ and duplicate reports (merged field-wise).
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from zipkin_tpu.model.span import Span, merge_spans
@@ -31,9 +33,9 @@ class SpanNode:
 
     def traverse(self) -> Iterator["SpanNode"]:
         """Breadth-first traversal (the order DependencyLinker relies on)."""
-        queue: List[SpanNode] = [self]
+        queue = collections.deque([self])
         while queue:
-            node = queue.pop(0)
+            node = queue.popleft()
             if node.span is not None:
                 yield node
             queue.extend(node.children)
@@ -169,8 +171,23 @@ def merge_trace(spans: Sequence[Span]) -> List[Span]:
     order them for presentation: by timestamp, then id, shared halves after
     their client halves.
 
-    Reference: ``zipkin2/internal/Trace.java#merge``.
+    Reference: ``zipkin2/internal/Trace.java#merge``, including its rendition
+    unification: when both a 128-bit and a 64-bit rendition of the trace id
+    appear (lenient trace-id mode during instrumentation migrations), 64-bit
+    spans are rewritten to the 128-bit form before merging, so duplicate
+    reports of one span collapse instead of surviving under two ids.
     """
+    tid128: Dict[str, str] = {}
+    for span in spans:
+        if len(span.trace_id) == 32:
+            tid128.setdefault(span.trace_id[16:], span.trace_id)
+    if tid128:
+        spans = [
+            dataclasses.replace(s, trace_id=tid128[s.trace_id])
+            if len(s.trace_id) == 16 and s.trace_id in tid128
+            else s
+            for s in spans
+        ]
     merged: Dict[tuple, Span] = {}
     for span in spans:
         key = span.key()

@@ -57,12 +57,23 @@ def _looks_like_v1_json(data: bytes) -> bool:
     return False
 
 
+def _looks_like_json(data: bytes) -> bool:
+    """Whitespace-tolerant JSON shape check: opens with [/{ and closes with
+    ]/} after stripping whitespace — disambiguates a leading 0x0a newline
+    from a proto3 field-1 header, which a first-byte test alone cannot."""
+    head = data[:256].lstrip(b" \t\r\n")
+    tail = data[-64:].rstrip(b" \t\r\n")
+    return head[:1] in (b"[", b"{") and tail[-1:] in (b"]", b"}")
+
+
 def detect(data: bytes) -> Encoding:
     """Sniff the encoding of an ingest payload from its first byte(s)."""
     if not data:
         raise ValueError("empty payload")
     first = data[0]
-    if first in (0x5B, 0x7B) or (first in (0x20, 0x09, 0x0D) and b"[" in data[:64]):
+    if first in (0x5B, 0x7B) or (
+        first in (0x20, 0x09, 0x0D, 0x0A) and _looks_like_json(data)
+    ):
         return Encoding.JSON_V1 if _looks_like_v1_json(data) else Encoding.JSON_V2
     if first == 0x0A:
         return Encoding.PROTO3

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import ipaddress
 import struct
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from zipkin_tpu.internal.hex import to_lower_hex
 from zipkin_tpu.model.json_v1 import (
@@ -134,7 +134,7 @@ def _read_endpoint(r: _Reader) -> Optional[Endpoint]:
     return Endpoint.create(service_name=service, ipv4=ipv4, ipv6=ipv6, port=port)
 
 
-def _read_annotation(r: _Reader) -> Tuple[Optional[V1Annotation], None]:
+def _read_annotation(r: _Reader) -> Optional[V1Annotation]:
     ts = 0
     value = ""
     host = None
@@ -152,8 +152,8 @@ def _read_annotation(r: _Reader) -> Tuple[Optional[V1Annotation], None]:
         else:
             r.skip(ftype)
     if ts <= 0 or not value:
-        return None, None
-    return V1Annotation(ts, value, host), None
+        return None
+    return V1Annotation(ts, value, host)
 
 
 _TYPE_BOOL = 0
@@ -214,7 +214,7 @@ def _read_v1_span(r: _Reader) -> V1Span:
         elif fid == 6 and ftype == _T_LIST:
             r.u8()  # element type (struct)
             for _ in range(r.i32()):
-                ann, _ = _read_annotation(r)
+                ann = _read_annotation(r)
                 if ann is not None:
                     annotations.append(ann)
         elif fid == 8 and ftype == _T_LIST:

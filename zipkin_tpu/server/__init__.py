@@ -1,0 +1,1 @@
+"""L4: the HTTP server exposing the Zipkin v2 API over any storage backend."""

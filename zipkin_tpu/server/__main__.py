@@ -1,0 +1,10 @@
+"""``python -m zipkin_tpu.server`` — boot from environment config."""
+
+import asyncio
+import logging
+
+from zipkin_tpu.server.app import run_server
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run_server())

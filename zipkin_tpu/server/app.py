@@ -1,0 +1,359 @@
+"""The HTTP server: Zipkin v2 API, collectors, health, and metrics.
+
+Reference semantics: ``zipkin-server`` (SURVEY.md §2.4) — the Armeria app
+rebuilt on aiohttp. Route-for-route:
+
+- ``POST /api/v2/spans`` and ``POST /api/v1/spans`` (+gzip, content-type or
+  first-byte format sniffing)   [``ZipkinHttpCollector.java``]
+- ``GET /api/v2/{traces,trace/{id},traceMany,services,spans,remoteServices,
+  dependencies,autocompleteKeys,autocompleteValues}``
+  [``ZipkinQueryApiV2.java``]
+- ``GET /health`` aggregating ``Component.check()``
+  [``ZipkinHealthController.java``]
+- ``GET /metrics`` (actuator counter names kept verbatim) and
+  ``GET /prometheus``
+- ``GET /config.json`` (UI config), ``GET /info``
+
+Ingest responds 202 as soon as the storage call is dispatched, mirroring
+the reference's enqueue-then-ack behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import json
+import logging
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+import zipkin_tpu
+from zipkin_tpu.collector.core import (
+    Collector,
+    CollectorSampler,
+    InMemoryCollectorMetrics,
+)
+from zipkin_tpu.internal.hex import normalize_trace_id
+from zipkin_tpu.model import codec, json_v2
+from zipkin_tpu.model.codec import Encoding
+from zipkin_tpu.server.config import ServerConfig
+from zipkin_tpu.storage.memory import InMemoryStorage
+from zipkin_tpu.storage.spi import QueryRequest, StorageComponent
+from zipkin_tpu.utils.component import Component
+
+logger = logging.getLogger(__name__)
+
+JSON = "application/json"
+
+
+def build_storage(config: ServerConfig) -> StorageComponent:
+    """STORAGE_TYPE -> StorageComponent, the autoconfig seam."""
+    common = dict(
+        strict_trace_id=config.strict_trace_id,
+        search_enabled=config.search_enabled,
+        autocomplete_keys=config.autocomplete_keys,
+    )
+    if config.storage_type == "mem":
+        return InMemoryStorage(max_span_count=config.mem_max_spans, **common)
+    if config.storage_type == "tpu":
+        from zipkin_tpu.storage.tpu import TpuStorage
+
+        return TpuStorage(
+            max_span_count=config.mem_max_spans,
+            batch_size=config.tpu_batch_size,
+            num_devices=config.tpu_devices,
+            checkpoint_dir=config.tpu_checkpoint_dir,
+            **common,
+        )
+    raise ValueError(f"unknown STORAGE_TYPE: {config.storage_type}")
+
+
+class ZipkinServer:
+    """Wires storage + collector + routes; owns component lifecycle."""
+
+    def __init__(
+        self,
+        config: Optional[ServerConfig] = None,
+        *,
+        storage: Optional[StorageComponent] = None,
+    ) -> None:
+        self.config = config or ServerConfig()
+        self.storage = storage if storage is not None else build_storage(self.config)
+        if self.config.throttle_enabled:
+            from zipkin_tpu.storage.throttle import ThrottledStorage
+
+            self.storage = ThrottledStorage(
+                self.storage, max_concurrency=self.config.throttle_max_concurrency
+            )
+        self.metrics = InMemoryCollectorMetrics()
+        self.collector = Collector(
+            self.storage,
+            sampler=CollectorSampler(self.config.sample_rate),
+            metrics=self.metrics.for_transport("http"),
+        )
+        self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- app ---------------------------------------------------------------
+
+    def make_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        r = app.router
+        if self.config.http_collector_enabled:
+            r.add_post("/api/v2/spans", self.post_spans_v2)
+            r.add_post("/api/v1/spans", self.post_spans_v1)
+        r.add_get("/api/v2/traces", self.get_traces)
+        r.add_get("/api/v2/trace/{trace_id}", self.get_trace)
+        r.add_get("/api/v2/traceMany", self.get_trace_many)
+        r.add_get("/api/v2/services", self.get_services)
+        r.add_get("/api/v2/spans", self.get_span_names)
+        r.add_get("/api/v2/remoteServices", self.get_remote_services)
+        r.add_get("/api/v2/dependencies", self.get_dependencies)
+        r.add_get("/api/v2/autocompleteKeys", self.get_autocomplete_keys)
+        r.add_get("/api/v2/autocompleteValues", self.get_autocomplete_values)
+        r.add_get("/health", self.get_health)
+        r.add_get("/info", self.get_info)
+        r.add_get("/metrics", self.get_metrics)
+        r.add_get("/prometheus", self.get_prometheus)
+        r.add_get("/config.json", self.get_ui_config)
+        return app
+
+    async def start(self) -> "ZipkinServer":
+        app = self.make_app()
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.host, self.config.port)
+        await site.start()
+        logger.info("zipkin-tpu listening on :%d", self.config.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        self.storage.close()
+
+    # -- ingest ------------------------------------------------------------
+
+    async def _read_body(self, request: web.Request) -> bytes:
+        # aiohttp transparently inflates Content-Encoding: gzip; the magic
+        # check also covers clients that compress without the header.
+        body = await request.read()
+        if body[:2] == b"\x1f\x8b":
+            body = gzip.decompress(body)
+        return body
+
+    async def post_spans_v2(self, request: web.Request) -> web.Response:
+        return await self._ingest(request, v1=False)
+
+    async def post_spans_v1(self, request: web.Request) -> web.Response:
+        return await self._ingest(request, v1=True)
+
+    async def _ingest(self, request: web.Request, *, v1: bool) -> web.Response:
+        try:
+            body = await self._read_body(request)
+        except Exception:
+            return web.Response(status=400, text="cannot gunzip body")
+        ctype = request.headers.get("Content-Type", "").split(";")[0].strip()
+        encoding: Optional[Encoding] = None
+        if ctype == "application/x-protobuf":
+            encoding = Encoding.PROTO3
+        elif ctype == "application/x-thrift":
+            encoding = Encoding.THRIFT
+        elif ctype == JSON and v1:
+            encoding = Encoding.JSON_V1
+        # else: sniff (covers missing/odd content types)
+        try:
+            await asyncio.to_thread(self.collector.accept_spans_bytes, body, encoding)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        return web.Response(status=202)
+
+    # -- query -------------------------------------------------------------
+
+    def _parse_query(self, request: web.Request) -> QueryRequest:
+        q = request.query
+
+        def opt_int(name: str) -> Optional[int]:
+            raw = q.get(name)
+            return int(raw) if raw else None
+
+        import time
+
+        end_ts = opt_int("endTs") or int(time.time() * 1000)
+        lookback = opt_int("lookback") or self.config.default_lookback
+        return QueryRequest(
+            end_ts=end_ts,
+            lookback=lookback,
+            limit=opt_int("limit") or self.config.query_limit,
+            service_name=q.get("serviceName"),
+            remote_service_name=q.get("remoteServiceName"),
+            span_name=q.get("spanName"),
+            annotation_query=parse_annotation_query(q.get("annotationQuery")),
+            min_duration=opt_int("minDuration"),
+            max_duration=opt_int("maxDuration"),
+        )
+
+    async def get_traces(self, request: web.Request) -> web.Response:
+        try:
+            query = self._parse_query(request)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        traces = await asyncio.to_thread(
+            lambda: self.storage.span_store().get_traces_query(query).execute()
+        )
+        return web.json_response(
+            [[json_v2.span_to_dict(s) for s in t] for t in traces]
+        )
+
+    async def get_trace(self, request: web.Request) -> web.Response:
+        raw_id = request.match_info["trace_id"]
+        try:
+            normalize_trace_id(raw_id)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        spans = await asyncio.to_thread(
+            lambda: self.storage.span_store().get_trace(raw_id).execute()
+        )
+        if not spans:
+            return web.Response(status=404, text=f"trace {raw_id} not found")
+        return web.json_response([json_v2.span_to_dict(s) for s in spans])
+
+    async def get_trace_many(self, request: web.Request) -> web.Response:
+        raw = request.query.get("traceIds", "")
+        ids = [x for x in raw.split(",") if x]
+        if not ids:
+            return web.Response(status=400, text="traceIds parameter is required")
+        traces = await asyncio.to_thread(
+            lambda: self.storage.traces().get_traces(ids).execute()
+        )
+        return web.json_response(
+            [[json_v2.span_to_dict(s) for s in t] for t in traces]
+        )
+
+    async def get_services(self, request: web.Request) -> web.Response:
+        names = await asyncio.to_thread(
+            lambda: self.storage.service_and_span_names().get_service_names().execute()
+        )
+        return web.json_response(names)
+
+    async def get_span_names(self, request: web.Request) -> web.Response:
+        service = request.query.get("serviceName", "")
+        names = await asyncio.to_thread(
+            lambda: self.storage.service_and_span_names()
+            .get_span_names(service)
+            .execute()
+        )
+        return web.json_response(names)
+
+    async def get_remote_services(self, request: web.Request) -> web.Response:
+        service = request.query.get("serviceName", "")
+        names = await asyncio.to_thread(
+            lambda: self.storage.service_and_span_names()
+            .get_remote_service_names(service)
+            .execute()
+        )
+        return web.json_response(names)
+
+    async def get_dependencies(self, request: web.Request) -> web.Response:
+        raw_end = request.query.get("endTs")
+        if not raw_end:
+            return web.Response(status=400, text="endTs parameter is required")
+        try:
+            end_ts = int(raw_end)
+            lookback = int(request.query.get("lookback") or self.config.default_lookback)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        links = await asyncio.to_thread(
+            lambda: self.storage.span_store().get_dependencies(end_ts, lookback).execute()
+        )
+        return web.json_response([json_v2.link_to_dict(x) for x in links])
+
+    async def get_autocomplete_keys(self, request: web.Request) -> web.Response:
+        keys = await asyncio.to_thread(
+            lambda: self.storage.autocomplete_tags().get_keys().execute()
+        )
+        return web.json_response(keys)
+
+    async def get_autocomplete_values(self, request: web.Request) -> web.Response:
+        key = request.query.get("key")
+        if not key:
+            return web.Response(status=400, text="key parameter is required")
+        values = await asyncio.to_thread(
+            lambda: self.storage.autocomplete_tags().get_values(key).execute()
+        )
+        return web.json_response(values)
+
+    # -- ops ---------------------------------------------------------------
+
+    async def get_health(self, request: web.Request) -> web.Response:
+        results = {}
+        overall_up = True
+        for name, component in self.components.items():
+            result = await asyncio.to_thread(component.check)
+            results[name] = {
+                "status": "UP" if result.ok else "DOWN",
+                **({"error": str(result.error)} if result.error else {}),
+            }
+            overall_up &= result.ok
+        body = {"status": "UP" if overall_up else "DOWN", "zipkin": results}
+        return web.json_response(body, status=200 if overall_up else 503)
+
+    async def get_info(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"zipkin": {"version": zipkin_tpu.__version__, "flavor": "tpu"}}
+        )
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        """Actuator-style counters, reference taxonomy kept verbatim:
+        ``counter.zipkin_collector.spans.http`` etc."""
+        out = {}
+        for key, value in self.metrics.snapshot().items():
+            transport, _, name = key.partition(".")
+            out[f"counter.zipkin_collector.{name}.{transport}"] = value
+        return web.json_response(out)
+
+    async def get_prometheus(self, request: web.Request) -> web.Response:
+        lines: List[str] = []
+        for key, value in sorted(self.metrics.snapshot().items()):
+            transport, _, name = key.partition(".")
+            lines.append(
+                f'zipkin_collector_{name}_total{{transport="{transport}"}} {value}'
+            )
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def get_ui_config(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "environment": "",
+                "queryLimit": self.config.query_limit,
+                "defaultLookback": self.config.default_lookback,
+                "searchEnabled": self.config.search_enabled,
+                "autocompleteKeys": list(self.config.autocomplete_keys),
+                "dependency": {"enabled": True},
+            }
+        )
+
+
+def parse_annotation_query(raw: Optional[str]) -> Dict[str, str]:
+    """Parse ``"error and http.method=GET"`` into ``{error: '', http.method:
+    'GET'}`` — the upstream annotationQuery grammar."""
+    out: Dict[str, str] = {}
+    if not raw:
+        return out
+    for token in raw.split(" and "):
+        token = token.strip()
+        if not token:
+            continue
+        key, sep, value = token.partition("=")
+        out[key] = value if sep else ""
+    return out
+
+
+async def run_server(config: Optional[ServerConfig] = None) -> None:
+    server = ZipkinServer(config or ServerConfig.from_env())
+    await server.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()

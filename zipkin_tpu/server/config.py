@@ -1,0 +1,88 @@
+"""Server configuration: a dataclass tree overridable by environment vars.
+
+Reference semantics: ``zipkin-server/src/main/resources/zipkin-server-
+shared.yml`` (SURVEY.md §2.4, §5) — the same env var names are honored where
+they exist upstream (``STORAGE_TYPE``, ``QUERY_PORT``, ``QUERY_LOOKBACK``,
+``COLLECTOR_SAMPLE_RATE``, ``SEARCH_ENABLED``, ``AUTOCOMPLETE_KEYS``,
+``STRICT_TRACE_ID``, ``MEM_MAX_SPANS``…), plus TPU-tier knobs that are new
+here (``TPU_*``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    return int(raw) if raw else default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    return float(raw) if raw else default
+
+
+def _env_list(name: str) -> Tuple[str, ...]:
+    raw = os.environ.get(name, "")
+    return tuple(x.strip() for x in raw.split(",") if x.strip())
+
+
+DAY_MS = 86_400_000
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 9411
+    storage_type: str = "mem"  # mem | tpu
+    strict_trace_id: bool = True
+    search_enabled: bool = True
+    autocomplete_keys: Sequence[str] = ()
+    mem_max_spans: int = 500_000
+    default_lookback: int = 7 * DAY_MS  # QUERY_LOOKBACK, ms
+    query_limit: int = 10
+    sample_rate: float = 1.0
+    http_collector_enabled: bool = True
+    grpc_collector_enabled: bool = False
+    grpc_port: int = 9412
+    throttle_enabled: bool = False
+    throttle_max_concurrency: int = 8
+    self_tracing_enabled: bool = False
+    # TPU aggregation tier
+    tpu_devices: Optional[int] = None  # None = all visible
+    tpu_batch_size: int = 8192
+    tpu_checkpoint_dir: Optional[str] = None
+
+    @staticmethod
+    def from_env() -> "ServerConfig":
+        return ServerConfig(
+            host=os.environ.get("QUERY_HOST", "0.0.0.0"),
+            port=_env_int("QUERY_PORT", 9411),
+            storage_type=os.environ.get("STORAGE_TYPE", "mem"),
+            strict_trace_id=_env_bool("STRICT_TRACE_ID", True),
+            search_enabled=_env_bool("SEARCH_ENABLED", True),
+            autocomplete_keys=_env_list("AUTOCOMPLETE_KEYS"),
+            mem_max_spans=_env_int("MEM_MAX_SPANS", 500_000),
+            default_lookback=_env_int("QUERY_LOOKBACK", 7 * DAY_MS),
+            query_limit=_env_int("QUERY_LIMIT", 10),
+            sample_rate=_env_float("COLLECTOR_SAMPLE_RATE", 1.0),
+            http_collector_enabled=_env_bool("COLLECTOR_HTTP_ENABLED", True),
+            grpc_collector_enabled=_env_bool("COLLECTOR_GRPC_ENABLED", False),
+            grpc_port=_env_int("COLLECTOR_GRPC_PORT", 9412),
+            throttle_enabled=_env_bool("STORAGE_THROTTLE_ENABLED", False),
+            throttle_max_concurrency=_env_int("STORAGE_THROTTLE_MAX_CONCURRENCY", 8),
+            self_tracing_enabled=_env_bool("SELF_TRACING_ENABLED", False),
+            tpu_devices=_env_int("TPU_DEVICES", 0) or None,
+            tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
+            tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
+        )
