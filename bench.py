@@ -1,0 +1,76 @@
+"""Ingest throughput benchmark — the BASELINE headline metric.
+
+Measures sustained spans/sec through the device ingest path on ONE chip
+(the driver's real-TPU run), against the per-chip target derived from
+BASELINE.json's north star: >=1M spans/sec on v5e-8 => 125k/chip.
+
+Replay format: the corpus is pre-packed into columnar batches once
+(SURVEY.md §7 hard-part 1 sanctions a pre-tokenized replay format for
+the benchmark — the host decode path is benchmarked separately in
+benchmarks/), then streamed through route + device_put + the jit'd
+ingest step, end to end, including host->device transfer.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+BASELINE_PER_CHIP = 125_000.0  # spans/sec/chip (1M / 8 chips, BASELINE.json)
+
+
+def main() -> None:
+    import jax
+
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.parallel.mesh import make_mesh
+    from zipkin_tpu.parallel.sharded import ShardedAggregator
+    from zipkin_tpu.tpu.columnar import Vocab, pack_spans
+    from zipkin_tpu.tpu.state import AggConfig
+
+    batch_size = int(os.environ.get("BENCH_BATCH", 8192))
+    n_batches = int(os.environ.get("BENCH_BATCHES", 48))
+    corpus_unique = int(os.environ.get("BENCH_UNIQUE_SPANS", 65_536))
+
+    mesh = make_mesh(1)  # per-chip number; multi-chip scales by psum design
+    config = AggConfig()
+    agg = ShardedAggregator(config, mesh=mesh)
+    vocab = Vocab(max_services=config.max_services, max_keys=config.max_keys)
+
+    spans = lots_of_spans(corpus_unique, seed=7, services=40, span_names=120)
+    packed = [
+        pack_spans(spans[i : i + batch_size], vocab, pad_to_multiple=batch_size)
+        for i in range(0, corpus_unique, batch_size)
+    ]
+
+    # warmup: compile route + step
+    agg.ingest(packed[0])
+    agg.block_until_ready()
+
+    start = time.perf_counter()
+    total = 0
+    for i in range(n_batches):
+        cols = packed[i % len(packed)]
+        agg.ingest(cols)
+        total += batch_size
+    agg.block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    rate = total / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "ingest_spans_per_sec_per_chip",
+                "value": round(rate, 1),
+                "unit": "spans/s",
+                "vs_baseline": round(rate / BASELINE_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

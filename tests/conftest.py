@@ -5,13 +5,28 @@ sharding logic (`shard_map`/`psum` over a Mesh) is exercised without a TPU
 pod — the rebuild's analog of the reference testing multi-node behavior
 against single-node containers (SURVEY.md §4). Must run before any jax
 import anywhere in the test process.
+
+NOTE: this environment's axon sitecustomize force-sets
+``JAX_PLATFORMS=axon`` before pytest starts, so a ``setdefault`` is not
+enough — hard-override both the env var and the jax config here, and
+assert the result at session start (a silent fallback to the single real
+TPU chip makes every device test slow and breaks 8-way meshes).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert len(jax.devices()) == 8, jax.devices()

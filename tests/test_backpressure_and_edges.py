@@ -1,0 +1,135 @@
+"""Regression tests for review findings: backpressure propagation, the
+Long.MIN_VALUE sampler edge, format sniffing vs proto3, gzip caps, and
+throttle-wrapped TPU extensions."""
+
+import asyncio
+import gzip
+import struct
+
+import pytest
+
+from tests.fixtures import TRACE
+from zipkin_tpu.collector.core import Collector, CollectorSampler
+from zipkin_tpu.model import codec, json_v2, proto3
+from zipkin_tpu.model.codec import Encoding
+from zipkin_tpu.model.span import Endpoint, Span
+from zipkin_tpu.storage.memory import InMemoryStorage
+from zipkin_tpu.storage.spi import SpanConsumer
+from zipkin_tpu.storage.throttle import RejectedExecutionError, ThrottledStorage
+from zipkin_tpu.utils.call import Call
+
+
+class TestSamplerEdge:
+    def test_long_min_value_is_sampled_at_rate_1(self):
+        # trace id low64 == 0x8000...0 -> Java Math.abs stays negative and
+        # passes; our arithmetic must match (mixed-fleet consistency).
+        assert CollectorSampler(1.0).is_sampled(1 << 63)
+
+    def test_long_min_value_sampled_at_any_rate(self):
+        assert CollectorSampler(0.001).is_sampled(1 << 63)
+
+    def test_boundary_consistency(self):
+        s = CollectorSampler(0.5)
+        for tid in (1, 123456789, (1 << 63) - 1, (1 << 64) - 1):
+            assert s.is_sampled(tid) == s.is_sampled(tid)  # deterministic
+
+
+class TestDetectProto3:
+    def test_proto3_with_brace_length_byte_not_json(self):
+        # span whose serialized length byte could be 0x7b and whose last
+        # byte is 0x7d: a string tag ending in '}' padded to 123 bytes.
+        span = Span.create(
+            "000000000000000a", "000000000000000b", name="x",
+            local_endpoint=Endpoint.create("svc"),
+            tags={"note": "a" * 70 + "}"},
+        )
+        body = proto3.encode_span_list([span])
+        assert body[0] == 0x0A
+        assert codec.detect(body) == Encoding.PROTO3
+        decoded = codec.decode_spans(body)
+        assert decoded[0].tags["note"].endswith("}")
+
+    def test_json_with_leading_space_still_json(self):
+        body = b"  " + json_v2.encode_span_list(TRACE)
+        assert codec.detect(body) == Encoding.JSON_V2
+
+
+class _RejectingConsumer(SpanConsumer):
+    def accept(self, spans):
+        def run():
+            raise RejectedExecutionError("queue full")
+
+        return Call.of(run)
+
+
+class _RejectingStorage(InMemoryStorage):
+    def span_consumer(self):
+        return _RejectingConsumer()
+
+
+class TestBackpressure:
+    def test_collector_propagates_rejection(self):
+        collector = Collector(_RejectingStorage())
+        with pytest.raises(RejectedExecutionError):
+            collector.accept(TRACE)
+
+    def test_http_maps_rejection_to_503(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from zipkin_tpu.server.app import ZipkinServer
+        from zipkin_tpu.server.config import ServerConfig
+
+        async def scenario():
+            server = ZipkinServer(ServerConfig(), storage=_RejectingStorage())
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 503
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_gzip_bomb_rejected_413(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from zipkin_tpu.server.app import ZipkinServer
+        from zipkin_tpu.server.config import ServerConfig
+
+        async def scenario():
+            server = ZipkinServer(ServerConfig(), storage=InMemoryStorage())
+            server.MAX_INFLATED = 1024 * 1024  # small cap for the test
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                bomb = gzip.compress(b"[" + b" " * (8 * 1024 * 1024) + b"]")
+                resp = await client.post(
+                    "/api/v2/spans", data=bomb,
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 413
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+
+class TestThrottleDelegation:
+    def test_extension_methods_visible_through_throttle(self):
+        class FakeTpu(InMemoryStorage):
+            def latency_quantiles(self, qs, service_name=None, span_name=None,
+                                  use_digest=True):
+                return ["row"]
+
+        wrapped = ThrottledStorage(FakeTpu())
+        assert hasattr(wrapped, "latency_quantiles")
+        assert wrapped.latency_quantiles([0.5]) == ["row"]
+
+    def test_missing_attr_still_raises(self):
+        wrapped = ThrottledStorage(InMemoryStorage())
+        with pytest.raises(AttributeError):
+            wrapped.definitely_not_a_method

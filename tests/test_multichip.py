@@ -1,0 +1,131 @@
+"""Multi-chip semantics on the 8-virtual-device CPU mesh (SURVEY.md §4):
+sharded results must equal single-shard results exactly (links, hist,
+counters) or identically-merged (HLL), and snapshots must round-trip.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.fixtures import lots_of_spans
+from zipkin_tpu.parallel.mesh import make_mesh
+from zipkin_tpu.parallel.sharded import ShardedAggregator, route_columns
+from zipkin_tpu.tpu.columnar import Vocab, pack_spans
+from zipkin_tpu.tpu.state import AggConfig
+
+CFG = AggConfig(
+    max_services=64, max_keys=256, hll_precision=9,
+    digest_centroids=32, ring_capacity=1 << 13,
+)
+
+
+def packed_corpus(n=3000, seed=3):
+    vocab = Vocab(max_services=64, max_keys=256)
+    spans = lots_of_spans(n, seed=seed)
+    return pack_spans(spans, vocab, pad_to_multiple=512), vocab, spans
+
+
+class TestRouting:
+    def test_trace_affinity(self):
+        cols, _, _ = packed_corpus()
+        routed = route_columns(cols, 8)
+        # every (shard, trace) pair: a trace's spans appear on exactly one shard
+        seen = {}
+        for d in range(8):
+            valid = routed.valid[d]
+            for th in np.unique(routed.trace_h[d][valid]):
+                assert seen.setdefault(int(th), d) == d
+        assert routed.valid.sum() == cols.valid.sum()
+
+    def test_padding_shape(self):
+        cols, _, _ = packed_corpus()
+        routed = route_columns(cols, 8, pad_to_multiple=128)
+        assert routed.valid.shape[0] == 8
+        assert routed.valid.shape[1] % 128 == 0
+
+
+class TestShardedParity:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        cols, vocab, spans = packed_corpus()
+        single = ShardedAggregator(CFG, mesh=make_mesh(1))
+        eight = ShardedAggregator(CFG, mesh=make_mesh(8))
+        # stream in three batches
+        n = cols.size
+        for agg in (single, eight):
+            for lo in range(0, n, 1024):
+                sub = type(cols)(*(f[lo : lo + 1024] for f in cols))
+                agg.ingest(sub)
+        return single, eight
+
+    def test_counters_match(self, pair):
+        single, eight = pair
+        _, _, c1 = single.merged_sketches()
+        _, _, c8 = eight.merged_sketches()
+        # span-level counters are shard-invariant; CTR_BATCHES counts
+        # per-shard sub-batches by design, so it scales with the mesh.
+        np.testing.assert_array_equal(c1[:4], c8[:4])
+
+    def test_histograms_match_exactly(self, pair):
+        single, eight = pair
+        h1, _, _ = single.merged_sketches()
+        h8, _, _ = eight.merged_sketches()
+        np.testing.assert_array_equal(h1, h8)
+
+    def test_hll_merge_matches(self, pair):
+        # trace-affine routing means each trace lives on one shard, so the
+        # pmax-merged registers equal the single-shard registers exactly.
+        single, eight = pair
+        _, r1, _ = single.merged_sketches()
+        _, r8, _ = eight.merged_sketches()
+        np.testing.assert_array_equal(r1, r8)
+
+    def test_dependency_links_match(self, pair):
+        single, eight = pair
+        c1, e1 = single.dependency_matrices(0, 2**31)
+        c8, e8 = eight.dependency_matrices(0, 2**31)
+        np.testing.assert_array_equal(c1, c8)
+        np.testing.assert_array_equal(e1, e8)
+
+
+class TestSnapshot:
+    def test_roundtrip(self, tmp_path):
+        from zipkin_tpu.storage.tpu import TpuStorage
+        from zipkin_tpu.tpu import snapshot
+
+        spans = lots_of_spans(800, seed=11)
+        a = TpuStorage(config=CFG, num_devices=8, checkpoint_dir=str(tmp_path))
+        a.accept(spans).execute()
+        end_ts = max(s.timestamp for s in spans) // 1000 + 60_000
+        want_links = sorted(
+            (l.parent, l.child, l.call_count, l.error_count)
+            for l in a.get_dependencies(end_ts, 7 * 86_400_000).execute()
+        )
+        want_counters = a.ingest_counters()
+        assert a.snapshot() == str(tmp_path)
+
+        b = TpuStorage(config=CFG, num_devices=8, checkpoint_dir=str(tmp_path))
+        got_links = sorted(
+            (l.parent, l.child, l.call_count, l.error_count)
+            for l in b.get_dependencies(end_ts, 7 * 86_400_000).execute()
+        )
+        assert got_links == want_links
+        got = b.ingest_counters()
+        assert got["spans"] == want_counters["spans"]
+        rows = b.latency_quantiles([0.5], use_digest=False)
+        assert rows
+
+    def test_incompatible_snapshot_ignored(self, tmp_path):
+        from zipkin_tpu.storage.tpu import TpuStorage
+
+        spans = lots_of_spans(100, seed=12)
+        a = TpuStorage(config=CFG, num_devices=8, checkpoint_dir=str(tmp_path))
+        a.accept(spans).execute()
+        a.snapshot()
+        other = AggConfig(
+            max_services=32, max_keys=128, hll_precision=8,
+            digest_centroids=16, ring_capacity=1 << 12,
+        )
+        b = TpuStorage(config=other, num_devices=8, checkpoint_dir=str(tmp_path))
+        assert b.ingest_counters()["spans"] == 0

@@ -1,0 +1,236 @@
+"""Device linker parity vs the host DependencyLinker oracle.
+
+The edge-case matrix of test_dependency_linker.py is the spec
+(SURVEY.md §4); here every case — plus randomized trace soups — must
+produce identical edge counts from ops/linker.py (BASELINE config[2]).
+"""
+
+import random
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.fixtures import TRACE, lots_of_spans
+from zipkin_tpu.internal.dependency_linker import link_traces
+from zipkin_tpu.model.span import Endpoint, Kind, Span
+from zipkin_tpu.ops import linker as dlink
+from zipkin_tpu.tpu.columnar import Vocab, pack_spans
+
+
+def _ep(name):
+    return Endpoint.create(name)
+
+
+def device_links(traces: Sequence[Sequence[Span]]) -> Dict[Tuple[str, str], Tuple[int, int]]:
+    spans = [s for t in traces for s in t]
+    vocab = Vocab(max_services=256, max_keys=1024)
+    cols = pack_spans(spans, vocab, pad_to_multiple=256)
+    x = dlink.LinkInput(
+        trace_h=jnp.asarray(cols.trace_h), tl0=jnp.asarray(cols.tl0),
+        tl1=jnp.asarray(cols.tl1), s0=jnp.asarray(cols.s0), s1=jnp.asarray(cols.s1),
+        p0=jnp.asarray(cols.p0), p1=jnp.asarray(cols.p1),
+        shared=jnp.asarray(cols.shared), kind=jnp.asarray(cols.kind),
+        svc=jnp.asarray(cols.svc), rsvc=jnp.asarray(cols.rsvc),
+        err=jnp.asarray(cols.err), valid=jnp.asarray(cols.valid),
+    )
+    calls, errors = dlink.link_window(x, num_services=256)
+    calls, errors = np.asarray(calls), np.asarray(errors)
+    out: Dict[Tuple[str, str], Tuple[int, int]] = {}
+    for p, c in zip(*np.nonzero(calls)):
+        out[(vocab.services.lookup(int(p)), vocab.services.lookup(int(c)))] = (
+            int(calls[p, c]), int(errors[p, c]),
+        )
+    return out
+
+
+def host_links(traces: Sequence[Sequence[Span]]) -> Dict[Tuple[str, str], Tuple[int, int]]:
+    return {
+        (l.parent, l.child): (l.call_count, l.error_count)
+        for l in link_traces(traces)
+    }
+
+
+def assert_parity(*traces: Sequence[Span]) -> None:
+    assert device_links(traces) == host_links(traces)
+
+
+class TestDeviceLinkerMatrix:
+    def test_canonical_trace(self):
+        assert_parity(TRACE)
+
+    def test_client_server_shared_pair(self):
+        assert_parity([
+            Span.create("1", "a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create("1", "a", kind="SERVER", shared=True, local_endpoint=_ep("b")),
+        ])
+
+    def test_uninstrumented_server_leaf_client(self):
+        assert_parity([
+            Span.create("1", "a", kind="CLIENT",
+                        local_endpoint=_ep("a"), remote_endpoint=_ep("db")),
+        ])
+
+    def test_uninstrumented_client_root_server(self):
+        assert_parity([
+            Span.create("1", "a", kind="SERVER",
+                        local_endpoint=_ep("b"), remote_endpoint=_ep("mobile")),
+        ])
+
+    def test_root_server_without_remote(self):
+        assert_parity([Span.create("1", "a", kind="SERVER", local_endpoint=_ep("b"))])
+
+    def test_separate_client_server_spans(self):
+        assert_parity([
+            Span.create("1", "a", kind="SERVER", local_endpoint=_ep("a")),
+            Span.create("1", "b", parent_id="a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create("1", "c", parent_id="b", kind="SERVER", local_endpoint=_ep("b")),
+        ])
+
+    def test_local_spans_transparent(self):
+        assert_parity([
+            Span.create("1", "a", kind="SERVER", local_endpoint=_ep("a")),
+            Span.create("1", "b", parent_id="a", local_endpoint=_ep("a"), name="local"),
+            Span.create("1", "c", parent_id="b", kind="CLIENT",
+                        local_endpoint=_ep("a"), remote_endpoint=_ep("b")),
+        ])
+
+    def test_messaging(self):
+        assert_parity([
+            Span.create("1", "a", kind="PRODUCER",
+                        local_endpoint=_ep("producer"), remote_endpoint=_ep("kafka")),
+            Span.create("1", "b", parent_id="a", kind="CONSUMER",
+                        local_endpoint=_ep("consumer"), remote_endpoint=_ep("kafka")),
+        ])
+
+    def test_messaging_without_broker(self):
+        assert_parity([
+            Span.create("1", "a", kind="PRODUCER", local_endpoint=_ep("producer")),
+        ])
+
+    def test_no_kind_with_both_sides(self):
+        assert_parity([
+            Span.create("1", "a", local_endpoint=_ep("a"), remote_endpoint=_ep("b")),
+        ])
+
+    def test_no_kind_without_remote(self):
+        assert_parity([Span.create("1", "a", local_endpoint=_ep("a"))])
+
+    def test_error_on_server_side(self):
+        assert_parity([
+            Span.create("1", "a", kind="CLIENT", local_endpoint=_ep("a")),
+            Span.create("1", "a", kind="SERVER", shared=True,
+                        local_endpoint=_ep("b"), tags={"error": "500"}),
+        ])
+
+    def test_client_error_on_leaf(self):
+        assert_parity([
+            Span.create("1", "a", kind="CLIENT", local_endpoint=_ep("a"),
+                        remote_endpoint=_ep("db"), tags={"error": "timeout"}),
+        ])
+
+    def test_loopback(self):
+        assert_parity([
+            Span.create("1", "a", kind="CLIENT",
+                        local_endpoint=_ep("a"), remote_endpoint=_ep("a")),
+        ])
+
+    def test_missing_local_service_skipped(self):
+        assert_parity([
+            Span.create("1", "a", kind="SERVER", remote_endpoint=_ep("mobile")),
+        ])
+
+    def test_counts_accumulate_across_traces(self):
+        t1 = [Span.create("1", "a", kind="CLIENT",
+                          local_endpoint=_ep("a"), remote_endpoint=_ep("db"))]
+        t2 = [Span.create("2", "a", kind="CLIENT",
+                          local_endpoint=_ep("a"), remote_endpoint=_ep("db"),
+                          tags={"error": "x"})]
+        assert_parity(t1, t2)
+
+    def test_dangling_parent(self):
+        assert_parity([
+            Span.create("1", "b", parent_id="dead", kind="SERVER",
+                        local_endpoint=_ep("b"), remote_endpoint=_ep("a")),
+        ])
+
+    def test_backfill_uninstrumented_hop(self):
+        assert_parity([
+            Span.create("1", "a", kind="SERVER", local_endpoint=_ep("a")),
+            Span.create("1", "b", parent_id="a", kind="CLIENT",
+                        local_endpoint=_ep("mid"), remote_endpoint=_ep("c")),
+        ])
+
+    def test_deep_chain_ancestor_climb(self):
+        # 20 kindless local spans between the server root and the leaf client:
+        # pointer doubling must climb past all of them.
+        spans = [Span.create("1", "a0", kind="SERVER", local_endpoint=_ep("a"))]
+        parent = "a0"
+        for i in range(20):
+            sid = f"b{i:02x}"
+            spans.append(Span.create("1", sid, parent_id=parent,
+                                     local_endpoint=_ep("a"), name="local"))
+            parent = sid
+        spans.append(Span.create("1", "fade", parent_id=parent, kind="CLIENT",
+                                 local_endpoint=_ep("a"), remote_endpoint=_ep("b")))
+        assert_parity(spans)
+
+
+class TestDeviceLinkerFuzz:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_lots_of_spans_parity(self, seed):
+        spans = lots_of_spans(2000, seed=seed)
+        traces: Dict[str, List[Span]] = {}
+        for s in spans:
+            traces.setdefault(s.trace_id, []).append(s)
+        tl = list(traces.values())
+        assert device_links(tl) == host_links(tl)
+
+    @pytest.mark.parametrize("seed", [7, 8])
+    def test_mixed_shapes_parity(self, seed):
+        rng = random.Random(seed)
+        traces: List[List[Span]] = []
+        svcs = [f"s{i}" for i in range(8)]
+        for t in range(120):
+            tid = f"{rng.getrandbits(63) | 1:016x}"
+            spans: List[Span] = []
+            root_svc = rng.choice(svcs)
+            spans.append(Span.create(tid, "0001", kind="SERVER",
+                                     local_endpoint=_ep(root_svc),
+                                     remote_endpoint=_ep("edge") if rng.random() < 0.5 else None))
+            frontier = [("0001", root_svc)]
+            sid = 1
+            for _ in range(rng.randint(0, 6)):
+                parent, psvc = rng.choice(frontier)
+                sid += 1
+                child_id = f"{sid:04x}"
+                style = rng.random()
+                callee = rng.choice(svcs)
+                err = {"error": "x"} if rng.random() < 0.2 else {}
+                if style < 0.35:  # client + shared server pair
+                    spans.append(Span.create(tid, child_id, parent_id=parent, kind="CLIENT",
+                                             local_endpoint=_ep(psvc), tags=err))
+                    spans.append(Span.create(tid, child_id, parent_id=parent, kind="SERVER",
+                                             shared=True, local_endpoint=_ep(callee)))
+                    frontier.append((child_id, callee))
+                elif style < 0.6:  # separate client/server spans
+                    spans.append(Span.create(tid, child_id, parent_id=parent, kind="CLIENT",
+                                             local_endpoint=_ep(psvc)))
+                    sid += 1
+                    srv_id = f"{sid:04x}"
+                    spans.append(Span.create(tid, srv_id, parent_id=child_id, kind="SERVER",
+                                             local_endpoint=_ep(callee), tags=err))
+                    frontier.append((srv_id, callee))
+                elif style < 0.8:  # leaf client to uninstrumented dep
+                    spans.append(Span.create(tid, child_id, parent_id=parent, kind="CLIENT",
+                                             local_endpoint=_ep(psvc),
+                                             remote_endpoint=_ep(rng.choice(["db", "cache"])),
+                                             tags=err))
+                else:  # kindless local span
+                    spans.append(Span.create(tid, child_id, parent_id=parent,
+                                             local_endpoint=_ep(psvc), name="local"))
+                    frontier.append((child_id, psvc))
+            rng.shuffle(spans)
+            traces.append(spans)
+        assert device_links(traces) == host_links(traces)

@@ -1,0 +1,198 @@
+"""Device sketch ops: accuracy + merge semantics (SURVEY.md §7 P2).
+
+Runs on the 8-virtual-device CPU backend configured in conftest.py; the
+same code path runs unmodified on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zipkin_tpu.ops import hashing, histogram, hll, segments, tdigest
+
+
+class TestHashing:
+    def test_fmix32_avalanche(self):
+        x = jnp.arange(1 << 16, dtype=jnp.uint32)
+        h = np.asarray(hashing.fmix32(x))
+        assert len(np.unique(h)) == 1 << 16  # fmix32 is a bijection
+        # bit balance: each output bit ~50% set
+        bits = ((h[:, None] >> np.arange(32)[None, :]) & 1).mean(axis=0)
+        assert np.all(np.abs(bits - 0.5) < 0.02)
+
+    def test_hash2_differs_from_lanes(self):
+        a = jnp.arange(1024, dtype=jnp.uint32)
+        b = jnp.zeros(1024, dtype=jnp.uint32)
+        assert len(np.unique(np.asarray(hashing.hash2(a, b)))) == 1024
+        assert not np.array_equal(
+            np.asarray(hashing.hash2(a, b)), np.asarray(hashing.hash2(b, a))
+        )
+
+    def test_floor_log2(self):
+        v = np.array([1, 2, 3, 4, 7, 8, 255, 256, 2**31, 2**32 - 1], np.uint32)
+        got = np.asarray(hashing.floor_log2(jnp.asarray(v)))
+        want = np.floor(np.log2(v.astype(np.float64))).astype(np.int32)
+        np.testing.assert_array_equal(got, want)
+
+
+class TestSegments:
+    def test_cumsum_and_total(self):
+        rng = np.random.default_rng(0)
+        ids = np.sort(rng.integers(0, 20, 500)).astype(np.int32)
+        vals = rng.random(500).astype(np.float32)
+        cum = np.asarray(segments.sorted_segment_cumsum(jnp.asarray(vals), jnp.asarray(ids)))
+        tot = np.asarray(segments.sorted_segment_total(jnp.asarray(vals), jnp.asarray(ids)))
+        for seg in np.unique(ids):
+            mask = ids == seg
+            np.testing.assert_allclose(cum[mask], np.cumsum(vals[mask]), rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(tot[mask], vals[mask].sum(), rtol=1e-4, atol=1e-4)
+
+    def test_single_run(self):
+        ids = jnp.zeros(16, jnp.int32)
+        vals = jnp.ones(16, jnp.float32)
+        assert float(segments.sorted_segment_total(vals, ids)[0]) == 16.0
+
+
+class TestHll:
+    @pytest.mark.parametrize("n", [100, 10_000, 500_000])
+    def test_estimate_within_error(self, n):
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, 2**63, n, dtype=np.uint64)
+        lo = jnp.asarray((ids & 0xFFFFFFFF).astype(np.uint32))
+        hi = jnp.asarray((ids >> np.uint64(32)).astype(np.uint32))
+        h = hashing.hash2(hi, lo)
+        regs = hll.new_registers(1, precision=11)
+        regs = jax.jit(hll.update)(regs, jnp.zeros(n, jnp.int32), h, jnp.ones(n, bool))
+        est = float(hll.estimate(regs)[0])
+        true = len(np.unique(ids))
+        assert abs(est - true) / true < 5 * hll.standard_error(11)
+
+    def test_merge_equals_union(self):
+        rng = np.random.default_rng(1)
+        a_ids = rng.integers(0, 2**32, 5000).astype(np.uint32)
+        b_ids = rng.integers(0, 2**32, 5000).astype(np.uint32)
+
+        def load(ids):
+            regs = hll.new_registers(1, precision=10)
+            h = hashing.hash2(jnp.asarray(ids), jnp.zeros(len(ids), jnp.uint32))
+            return hll.update(regs, jnp.zeros(len(ids), jnp.int32), h, jnp.ones(len(ids), bool))
+
+        merged = hll.merge(load(a_ids), load(b_ids))
+        both = load(np.concatenate([a_ids, b_ids]))
+        np.testing.assert_array_equal(np.asarray(merged), np.asarray(both))
+
+    def test_rows_independent(self):
+        regs = hll.new_registers(4, precision=8)
+        h = hashing.fmix32(jnp.arange(1000, dtype=jnp.uint32))
+        regs = hll.update(regs, jnp.full(1000, 2, jnp.int32), h, jnp.ones(1000, bool))
+        est = np.asarray(hll.estimate(regs))
+        assert est[2] > 500
+        assert est[0] == est[1] == est[3] == 0.0
+
+    def test_invalid_lanes_ignored(self):
+        regs = hll.new_registers(1, precision=8)
+        h = hashing.fmix32(jnp.arange(100, dtype=jnp.uint32))
+        regs = hll.update(regs, jnp.zeros(100, jnp.int32), h, jnp.zeros(100, bool))
+        assert float(hll.estimate(regs)[0]) == 0.0
+
+
+class TestHistogram:
+    def test_bucket_monotone_and_bounds(self):
+        v = jnp.asarray(
+            np.unique(np.concatenate([np.arange(0, 4096), 2 ** np.arange(32, dtype=np.int64) - 1])
+                      .clip(0, 2**32 - 1)).astype(np.uint32))
+        b = np.asarray(histogram.bucket_of(v))
+        assert b.min() >= 0 and b.max() < histogram.BUCKETS
+        assert np.all(np.diff(b) >= 0)
+        lo, width = histogram.bucket_bounds(jnp.asarray(b))
+        lo, width = np.asarray(lo), np.asarray(width)
+        vv = np.asarray(v, np.float64)
+        assert np.all(vv >= lo - 1e-6)
+        assert np.all(vv < lo + width + 1e-6)
+
+    def test_relative_error_bound(self):
+        rng = np.random.default_rng(3)
+        vals = np.exp(rng.uniform(0, 17, 200_000)).astype(np.uint32) + 1
+        h = histogram.new_histograms(1)
+        h = jax.jit(histogram.update)(
+            h, jnp.zeros(len(vals), jnp.int32), jnp.asarray(vals), jnp.ones(len(vals), bool)
+        )
+        qs = np.array([0.5, 0.9, 0.99, 0.999], np.float32)
+        got = np.asarray(histogram.quantile(h, jnp.asarray(qs)))[0]
+        want = np.quantile(vals.astype(np.float64), qs)
+        np.testing.assert_allclose(got, want, rtol=2.0 / histogram.SUB)
+
+    def test_merge_is_addition_and_exact(self):
+        rng = np.random.default_rng(4)
+        a_vals, b_vals = rng.integers(1, 10**6, 10_000, np.uint32), rng.integers(1, 10**6, 10_000, np.uint32)
+
+        def load(vals):
+            h = histogram.new_histograms(2)
+            keys = jnp.asarray((vals % 2).astype(np.int32))
+            return histogram.update(h, keys, jnp.asarray(vals), jnp.ones(len(vals), bool))
+
+        merged = histogram.merge(load(a_vals), load(b_vals))
+        both = load(np.concatenate([a_vals, b_vals]))
+        np.testing.assert_array_equal(np.asarray(merged), np.asarray(both))
+
+    def test_counts(self):
+        h = histogram.new_histograms(3)
+        keys = jnp.asarray([0, 0, 1, 2, 2, 2], jnp.int32)
+        durs = jnp.asarray([5, 10, 100, 7, 7, 2**20], jnp.uint32)
+        h = histogram.update(h, keys, durs, jnp.ones(6, bool))
+        np.testing.assert_array_equal(np.asarray(histogram.total_count(h)), [2, 1, 3])
+
+
+class TestTDigest:
+    def test_accuracy_streaming(self):
+        rng = np.random.default_rng(5)
+        d = tdigest.new_digests(1, centroids=64)
+        all_vals = []
+        upd = jax.jit(tdigest.update)
+        for _ in range(20):
+            vals = np.exp(rng.normal(8, 2, 8192)).astype(np.float32)
+            all_vals.append(vals)
+            d = upd(d, jnp.zeros(8192, jnp.int32), jnp.asarray(vals), jnp.ones(8192, jnp.float32))
+        vals = np.concatenate(all_vals)
+        qs = np.array([0.5, 0.9, 0.99], np.float32)
+        got = np.asarray(tdigest.quantile(d, jnp.asarray(qs)))[0]
+        want = np.quantile(vals.astype(np.float64), qs)
+        np.testing.assert_allclose(got, want, rtol=0.05)
+        # total weight preserved exactly
+        assert float(jnp.sum(d[..., 1])) == pytest.approx(len(vals))
+
+    def test_multi_slot_isolation(self):
+        d = tdigest.new_digests(3, centroids=32)
+        slots = jnp.asarray([0] * 100 + [2] * 100, jnp.int32)
+        vals = jnp.concatenate([jnp.full(100, 10.0), jnp.full(100, 1000.0)])
+        d = tdigest.update(d, slots, vals, jnp.ones(200, jnp.float32))
+        q = np.asarray(tdigest.quantile(d, jnp.asarray([0.5], jnp.float32)))
+        assert q[0, 0] == pytest.approx(10.0, rel=0.01)
+        assert q[1, 0] == 0.0
+        assert q[2, 0] == pytest.approx(1000.0, rel=0.01)
+
+    def test_merge_matches_combined(self):
+        rng = np.random.default_rng(6)
+        a_vals = rng.gamma(2, 100, 20_000).astype(np.float32)
+        b_vals = rng.gamma(9, 50, 20_000).astype(np.float32)
+
+        def load(vals):
+            d = tdigest.new_digests(1, centroids=64)
+            return tdigest.update(
+                d, jnp.zeros(len(vals), jnp.int32), jnp.asarray(vals),
+                jnp.ones(len(vals), jnp.float32))
+
+        merged = tdigest.merge(load(a_vals), load(b_vals))
+        vals = np.concatenate([a_vals, b_vals])
+        qs = np.array([0.1, 0.5, 0.9, 0.99], np.float32)
+        got = np.asarray(tdigest.quantile(merged, jnp.asarray(qs)))[0]
+        want = np.quantile(vals.astype(np.float64), qs)
+        np.testing.assert_allclose(got, want, rtol=0.06)
+
+    def test_zero_weight_lanes_inert(self):
+        d = tdigest.new_digests(1, centroids=16)
+        d = tdigest.update(
+            d, jnp.zeros(8, jnp.int32), jnp.full(8, 123.0), jnp.zeros(8, jnp.float32)
+        )
+        assert float(jnp.sum(d[..., 1])) == 0.0

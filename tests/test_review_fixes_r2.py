@@ -1,0 +1,137 @@
+"""Regression tests for the second review round: ring padding must not
+clobber retained spans, multi-member gzip, transport retry on storage
+failure, wrap-free counters."""
+
+import asyncio
+import gzip
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tests.fixtures import TRACE, lots_of_spans
+from zipkin_tpu.collector.core import Collector
+from zipkin_tpu.collector.transports import QueueSource, TransportCollector
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.storage.memory import InMemoryStorage
+from zipkin_tpu.storage.spi import SpanConsumer
+from zipkin_tpu.storage.throttle import RejectedExecutionError
+from zipkin_tpu.tpu.columnar import Vocab, pack_spans
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.utils.call import Call
+
+
+class TestRingPadding:
+    def test_small_batches_do_not_erase_retained_spans(self):
+        """A trickle of tiny, heavily padded batches must not clobber
+        previously retained ring slots ahead of the cursor."""
+        from zipkin_tpu.parallel.mesh import make_mesh
+        from zipkin_tpu.parallel.sharded import ShardedAggregator
+
+        cfg = AggConfig(max_services=32, max_keys=64, hll_precision=8,
+                        digest_centroids=16, ring_capacity=2048)
+        agg = ShardedAggregator(cfg, mesh=make_mesh(1))
+        vocab = Vocab(32, 64)
+
+        big = lots_of_spans(600, seed=1)
+        agg.ingest(pack_spans(big, vocab, pad_to_multiple=256))
+        calls_before, _ = agg.dependency_matrices(0, 2**31)
+        total_before = int(calls_before.sum())
+        assert total_before > 0
+
+        # 30 one-span batches, each padded to 256 (255 pad lanes apiece —
+        # enough to wipe most of the 2048-slot ring if pads were written)
+        for i in range(30):
+            one = lots_of_spans(1, seed=100 + i)
+            agg.ingest(pack_spans(one, vocab, pad_to_multiple=256))
+
+        calls_after, _ = agg.dependency_matrices(0, 2**31)
+        # every original edge is still there (plus the new singles)
+        assert int(calls_after.sum()) >= total_before
+        live = int(np.asarray(agg.state.r_valid).sum())
+        assert live == 630  # 600 + 30, no pad-lane erasure
+
+
+class TestMultiMemberGzip:
+    def test_concatenated_gzip_members_fully_decoded(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from zipkin_tpu.server.app import ZipkinServer
+        from zipkin_tpu.server.config import ServerConfig
+
+        async def scenario():
+            storage = InMemoryStorage()
+            server = ZipkinServer(ServerConfig(), storage=storage)
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                half1 = json_v2.encode_span_list(TRACE[:2])
+                half2 = json_v2.encode_span_list(TRACE[2:])
+                body = gzip.compress(half1) + gzip.compress(half2)
+                resp = await client.post(
+                    "/api/v2/spans", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                # both members must land; a 202 with only half stored is
+                # the bug this guards against
+                assert resp.status in (202, 400)
+                if resp.status == 202:
+                    assert storage.span_count == len(TRACE)
+                else:
+                    assert storage.span_count == 0  # rejected whole, not half
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+
+class _FlakyStorage(InMemoryStorage):
+    """Rejects the first N accepts, then works."""
+
+    def __init__(self, fail_first: int) -> None:
+        super().__init__()
+        self._fails_left = fail_first
+
+    def span_consumer(self) -> SpanConsumer:
+        outer = self
+
+        class _C(SpanConsumer):
+            def accept(self, spans):
+                def run():
+                    if outer._fails_left > 0:
+                        outer._fails_left -= 1
+                        raise RejectedExecutionError("throttled")
+                    return InMemoryStorage.accept(outer, spans).execute()
+
+                return Call.of(run)
+
+        return _C()
+
+
+class TestTransportRetry:
+    def test_transient_storage_failure_loses_nothing(self):
+        storage = _FlakyStorage(fail_first=2)
+        source = QueueSource()
+        tc = TransportCollector(source, Collector(storage), transport="queue")
+        for i in range(5):
+            source.send(json_v2.encode_span_list([TRACE[i % len(TRACE)]]))
+        tc.drain(5.0)
+        # all 5 messages eventually stored despite 2 rejections
+        assert storage.span_count == 5
+        tc.close()
+
+
+class TestCounters:
+    def test_host_counters_survive_many_batches(self):
+        from zipkin_tpu.parallel.mesh import make_mesh
+        from zipkin_tpu.parallel.sharded import ShardedAggregator
+
+        cfg = AggConfig(max_services=16, max_keys=32, hll_precision=8,
+                        digest_centroids=16, ring_capacity=1024)
+        agg = ShardedAggregator(cfg, mesh=make_mesh(1))
+        vocab = Vocab(16, 32)
+        spans = lots_of_spans(100, seed=2)
+        for _ in range(3):
+            agg.ingest(pack_spans(spans, vocab, pad_to_multiple=128))
+        assert agg.host_counters["spans"] == 300
+        assert agg.host_counters["batches"] == 3

@@ -1,0 +1,116 @@
+"""Server E2E with STORAGE_TYPE=tpu: the BASELINE config[0] smoke test
+through the device tier, plus the sketch-extension endpoints.
+
+Mirrors ITZipkinServer (SURVEY.md §4) but with the TPU storage wired via
+the same autoconfig seam the reference uses (STORAGE_TYPE env).
+"""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.fixtures import TRACE, TODAY, lots_of_spans
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.server.app import ZipkinServer
+from zipkin_tpu.server.config import ServerConfig
+from zipkin_tpu.storage.tpu import TpuStorage
+from zipkin_tpu.tpu.state import AggConfig
+
+DAY_MS = 86_400_000
+QUERY_TS = TODAY + 3_600_000
+
+SMALL = AggConfig(
+    max_services=64, max_keys=256, hll_precision=9,
+    digest_centroids=32, ring_capacity=1 << 13,
+)
+
+
+def run(scenario):
+    async def wrapper():
+        storage = TpuStorage(config=SMALL, num_devices=8)
+        server = ZipkinServer(
+            ServerConfig(default_lookback=DAY_MS, storage_type="tpu"),
+            storage=storage,
+        )
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await scenario(client)
+        finally:
+            await client.close()
+
+    asyncio.run(wrapper())
+
+
+class TestTpuServer:
+    def test_post_trace_query_back_and_dependencies(self):
+        async def scenario(client):
+            resp = await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 202
+            resp = await client.get(f"/api/v2/trace/{TRACE[0].trace_id}")
+            assert resp.status == 200
+            got = await resp.json()
+            assert len(got) == len(TRACE)
+
+            resp = await client.get(
+                f"/api/v2/dependencies?endTs={QUERY_TS}&lookback={DAY_MS}"
+            )
+            assert resp.status == 200
+            links = {(l["parent"], l["child"]): l for l in await resp.json()}
+            assert links[("frontend", "backend")]["callCount"] == 1
+            assert links[("backend", "mysql")]["errorCount"] == 1
+
+        run(scenario)
+
+    def test_percentile_and_cardinality_endpoints(self):
+        async def scenario(client):
+            spans = lots_of_spans(1500, seed=21, services=5, span_names=6)
+            resp = await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(spans),
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 202
+
+            resp = await client.get("/api/v2/tpu/percentiles?q=0.5,0.99")
+            assert resp.status == 200
+            rows = await resp.json()
+            assert rows and all("quantiles" in r for r in rows)
+
+            one_svc = rows[0]["serviceName"]
+            resp = await client.get(
+                f"/api/v2/tpu/percentiles?serviceName={one_svc}&sketch=hist"
+            )
+            assert resp.status == 200
+            svc_rows = await resp.json()
+            assert svc_rows and all(r["serviceName"] == one_svc for r in svc_rows)
+
+            resp = await client.get("/api/v2/tpu/cardinalities")
+            assert resp.status == 200
+            cards = await resp.json()
+            true_traces = len({s.trace_id for s in spans})
+            assert abs(cards["_global"] - true_traces) / true_traces < 0.15
+
+            resp = await client.get("/api/v2/tpu/counters")
+            assert resp.status == 200
+            counters = await resp.json()
+            assert counters["spans"] == len(spans)
+
+            resp = await client.get("/api/v2/tpu/percentiles?q=1.5")
+            assert resp.status == 400
+
+            resp = await client.post("/api/v2/tpu/snapshot")
+            assert resp.status == 409  # no checkpoint_dir configured
+
+        run(scenario)
+
+    def test_health_includes_tpu_storage(self):
+        async def scenario(client):
+            resp = await client.get("/health")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["status"] == "UP"
+
+        run(scenario)

@@ -1,0 +1,138 @@
+"""TpuStorage: full storage-contract compliance + sketch parity vs oracle.
+
+The rebuild's key test pattern (SURVEY.md §4): one parity suite runs
+against {oracle, TPU store} and asserts equal (or ε-bounded for sketches)
+answers. Runs on the 8-virtual-device CPU mesh from conftest.py.
+"""
+
+import numpy as np
+import pytest
+
+from tests.fixtures import TRACE, lots_of_spans
+from tests.storage_contract import StorageContract
+from zipkin_tpu.storage.memory import InMemoryStorage
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+SMALL = AggConfig(
+    max_services=128, max_keys=512, hll_precision=10,
+    digest_centroids=32, ring_capacity=1 << 14,
+)
+
+
+def small_store(**kwargs) -> TpuStorage:
+    kwargs.setdefault("config", SMALL)
+    kwargs.setdefault("pad_to_multiple", 256)
+    return TpuStorage(**kwargs)
+
+
+class TestTpuStorageContract(StorageContract):
+    """The identical suite the oracle passes (ITStorage/ITSpanStore/...)."""
+
+    def make_storage(self, **kwargs) -> TpuStorage:
+        return small_store(**kwargs)
+
+
+class TestTpuAggregateParity:
+    @pytest.fixture(scope="class")
+    def loaded(self):
+        spans = lots_of_spans(6000, seed=42, services=6, span_names=8)
+        oracle = InMemoryStorage(max_span_count=100_000)
+        store = small_store(archive_max_span_count=100_000)
+        # feed in several batches to exercise streaming merges
+        for i in range(0, len(spans), 1000):
+            chunk = spans[i : i + 1000]
+            oracle.accept(chunk).execute()
+            store.accept(chunk).execute()
+        return spans, oracle, store
+
+    def test_dependency_link_parity(self, loaded):
+        spans, oracle, store = loaded
+        end_ts = max(s.timestamp for s in spans) // 1000 + 60_000
+        lookback = 7 * 86_400_000
+        want = {
+            (l.parent, l.child): (l.call_count, l.error_count)
+            for l in oracle.get_dependencies(end_ts, lookback).execute()
+        }
+        got = {
+            (l.parent, l.child): (l.call_count, l.error_count)
+            for l in store.get_dependencies(end_ts, lookback).execute()
+        }
+        assert got == want
+
+    def test_quantile_parity_within_epsilon(self, loaded):
+        spans, _, store = loaded
+        rows = store.latency_quantiles([0.5, 0.99], use_digest=False)
+        assert rows, "expected sketch rows"
+        # exact per-key durations from the raw spans
+        by_key = {}
+        for s in spans:
+            if s.duration is None:
+                continue
+            by_key.setdefault((s.local_service_name, s.name), []).append(s.duration)
+        checked = 0
+        for row in rows:
+            durs = np.asarray(by_key[(row["serviceName"], row["spanName"])], np.float64)
+            assert row["count"] == len(durs)
+            p50, p99 = row["quantiles"][0.5], row["quantiles"][0.99]
+            np.testing.assert_allclose(p50, np.quantile(durs, 0.5), rtol=0.10)
+            if len(durs) >= 100:
+                # the sketch's guarantee: p99 lies between the bracketing
+                # order statistics, within the bucket's relative width
+                # (heavy-tail gaps between top order stats are estimator
+                # variance, not sketch error).
+                lo = np.quantile(durs, 0.99, method="lower") * 0.96
+                hi = np.quantile(durs, 0.99, method="higher") * 1.04
+                assert lo <= p99 <= hi, (p99, lo, hi)
+                checked += 1
+        assert checked > 5
+
+    def test_digest_quantiles_tighter_tail(self, loaded):
+        spans, _, store = loaded
+        rows = store.latency_quantiles([0.5, 0.99], use_digest=True)
+        by_key = {}
+        for s in spans:
+            if s.duration is None:
+                continue
+            by_key.setdefault((s.local_service_name, s.name), []).append(s.duration)
+        for row in rows:
+            durs = np.asarray(by_key[(row["serviceName"], row["spanName"])], np.float64)
+            if len(durs) < 50:
+                continue
+            np.testing.assert_allclose(
+                row["quantiles"][0.5], np.quantile(durs, 0.5), rtol=0.15
+            )
+
+    def test_cardinality_parity(self, loaded):
+        spans, _, store = loaded
+        est = store.trace_cardinalities()
+        true_global = len({s.trace_id for s in spans})
+        assert abs(est["_global"] - true_global) / true_global < 0.1
+        by_svc = {}
+        for s in spans:
+            by_svc.setdefault(s.local_service_name, set()).add(s.trace_id)
+        for svc, tids in by_svc.items():
+            if len(tids) < 100:
+                continue
+            assert abs(est[svc] - len(tids)) / len(tids) < 0.15, svc
+
+    def test_ingest_counters(self, loaded):
+        spans, _, store = loaded
+        counters = store.ingest_counters()
+        assert counters["spans"] == len(spans)
+        assert counters["spansWithDuration"] == sum(
+            1 for s in spans if s.duration is not None
+        )
+
+    def test_aggregates_survive_archive_eviction(self):
+        """The point of the sketch tier: aggregate reads outlive raw
+        retention (SURVEY.md §5 long-context row)."""
+        store = small_store(archive_max_span_count=50)
+        spans = lots_of_spans(500, seed=9)
+        store.accept(spans).execute()
+        assert store._archive.span_count <= 50
+        counters = store.ingest_counters()
+        assert counters["spans"] == 500
+        end_ts = max(s.timestamp for s in spans) // 1000 + 60_000
+        links = store.get_dependencies(end_ts, 7 * 86_400_000).execute()
+        assert links  # still answerable from device

@@ -49,7 +49,10 @@ class CollectorSampler:
         if debug:
             return True
         signed = trace_id_low64 - (1 << 64) if trace_id_low64 >= (1 << 63) else trace_id_low64
-        t = abs(signed)
+        # Java parity: Math.abs(Long.MIN_VALUE) stays negative, so that one
+        # id always passes `t <= boundary`; Python abs() would overflow to
+        # 2**63 and wrongly drop it even at rate 1.0.
+        t = signed if signed == -(1 << 63) else abs(signed)
         return t <= self._boundary
 
     def test(self, span: Span) -> bool:
@@ -171,9 +174,15 @@ class Collector:
             return 0
         try:
             self._consumer.accept(sampled).execute()
-        except Exception:
-            logger.exception("cannot store %d spans", len(sampled))
+        except Exception as e:
+            from zipkin_tpu.storage.throttle import RejectedExecutionError
+
             self.metrics.increment_spans_dropped(len(sampled))
+            if isinstance(e, RejectedExecutionError):
+                # backpressure must reach the transport so senders back off
+                # (the reference maps RejectedExecutionException to 503)
+                raise
+            logger.exception("cannot store %d spans", len(sampled))
             return 0
         return len(sampled)
 

@@ -1,0 +1,332 @@
+"""Broker transports: the poll->decode->sample->store loops.
+
+Reference semantics: ``zipkin-collector/{kafka,rabbitmq,activemq}``
+(SURVEY.md §2.2, §3.3) — N workers polling a source, handing raw bytes to
+``Collector.accept_spans_bytes`` (format auto-detection + sampling +
+storage), committing offsets only after accept so delivery is
+at-least-once (duplicates possible; storage dedups or bounded
+double-count, SURVEY.md §3.3).
+
+Because this image has no broker clients installed, the transport seam is
+a tiny :class:`MessageSource` protocol with three in-repo sources:
+
+- :class:`QueueSource` — in-process queue (the unit-test broker, playing
+  the role the reference's testcontainers play).
+- :class:`ReplayFileSource` — length-prefixed message log with a durable
+  offset marker: both the replay-benchmark feed (BASELINE config[4]) and
+  the crash-resume story (Kafka-offset analog, SURVEY.md §5).
+- ``KafkaSource`` — real Kafka via kafka-python **if importable**;
+  otherwise construction raises with a clear message. The collector
+  structure (workers, commit discipline) is identical either way.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from zipkin_tpu.collector.core import (
+    Collector,
+    CollectorComponent,
+    CollectorMetrics,
+    InMemoryCollectorMetrics,
+)
+from zipkin_tpu.utils.component import CheckResult
+
+# -- the transport seam ---------------------------------------------------
+
+
+class Message:
+    """One opaque payload plus its resume offset."""
+
+    __slots__ = ("payload", "offset")
+
+    def __init__(self, payload: bytes, offset: int) -> None:
+        self.payload = payload
+        self.offset = offset
+
+
+class MessageSource:
+    """Minimal consumer contract: poll / commit / close."""
+
+    def poll(self, max_messages: int, timeout: float) -> List[Message]:
+        raise NotImplementedError
+
+    def commit(self, offset: int) -> None:
+        """Mark everything up to ``offset`` (inclusive) as consumed."""
+
+    def check(self) -> CheckResult:
+        return CheckResult.OK
+
+    def close(self) -> None: ...
+
+
+class QueueSource(MessageSource):
+    """In-process broker stand-in (bounded, drop-oldest-never: put blocks)."""
+
+    def __init__(self, maxsize: int = 10_000) -> None:
+        import queue
+
+        self._q: "queue.Queue[bytes]" = __import__("queue").Queue(maxsize)
+        self._seq = 0
+        self.committed = -1
+
+    def send(self, payload: bytes) -> None:
+        self._q.put(payload)
+
+    def poll(self, max_messages: int, timeout: float) -> List[Message]:
+        import queue
+
+        out: List[Message] = []
+        deadline = time.monotonic() + timeout
+        while len(out) < max_messages:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                payload = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            out.append(Message(payload, self._seq))
+            self._seq += 1
+        return out
+
+    def commit(self, offset: int) -> None:
+        self.committed = max(self.committed, offset)
+
+
+class ReplayFileSource(MessageSource):
+    """Length-prefixed message log (``u32 big-endian length + payload``)*
+    with a sidecar ``.offset`` marker for resume.
+
+    Writer half (:func:`append_replay`) + reader half in one class: the
+    file format doubles as the pre-tokenized ingest corpus for replay
+    benchmarks and as a write-ahead log for crash recovery (SURVEY.md §5
+    failure-detection row).
+    """
+
+    def __init__(self, path: str, *, resume: bool = True) -> None:
+        self.path = path
+        self.offset_path = path + ".offset"
+        self._file = open(path, "rb")
+        self._index = 0
+        self.committed = -1
+        if resume and os.path.exists(self.offset_path):
+            with open(self.offset_path) as f:
+                committed = int(f.read().strip() or -1)
+            self.committed = committed
+            # skip already-consumed messages
+            while self._index <= committed:
+                if self._read_one() is None:
+                    break
+
+    def _read_one(self) -> Optional[bytes]:
+        header = self._file.read(4)
+        if len(header) < 4:
+            return None
+        (length,) = struct.unpack(">I", header)
+        payload = self._file.read(length)
+        if len(payload) < length:
+            return None
+        self._index += 1
+        return payload
+
+    def poll(self, max_messages: int, timeout: float) -> List[Message]:
+        out: List[Message] = []
+        for _ in range(max_messages):
+            payload = self._read_one()
+            if payload is None:
+                break
+            out.append(Message(payload, self._index - 1))
+        return out
+
+    def commit(self, offset: int) -> None:
+        if offset <= self.committed:
+            return
+        self.committed = offset
+        tmp = self.offset_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(offset))
+        os.replace(tmp, self.offset_path)
+
+    def check(self) -> CheckResult:
+        return (
+            CheckResult.OK
+            if not self._file.closed
+            else CheckResult.failed(RuntimeError("replay file closed"))
+        )
+
+    def close(self) -> None:
+        self._file.close()
+
+
+def append_replay(path: str, payloads: Sequence[bytes]) -> None:
+    """Append messages to a replay log (writer half of ReplayFileSource)."""
+    with open(path, "ab") as f:
+        for p in payloads:
+            f.write(struct.pack(">I", len(p)))
+            f.write(p)
+
+
+class KafkaSource(MessageSource):
+    """Kafka consumer over kafka-python, if installed.
+
+    Mirrors ``KafkaCollectorWorker``'s poll loop; offsets commit through
+    the consumer group (at-least-once).
+    """
+
+    def __init__(
+        self,
+        bootstrap_servers: str,
+        topic: str = "zipkin",
+        group_id: str = "zipkin",
+    ) -> None:
+        try:
+            from kafka import KafkaConsumer  # type: ignore
+        except ImportError as e:  # pragma: no cover - not in this image
+            raise RuntimeError(
+                "kafka-python is not installed; use ReplayFileSource or "
+                "QueueSource, or install kafka-python"
+            ) from e
+        self._consumer = KafkaConsumer(  # pragma: no cover
+            topic,
+            bootstrap_servers=bootstrap_servers.split(","),
+            group_id=group_id,
+            enable_auto_commit=False,
+        )
+
+    def poll(self, max_messages, timeout):  # pragma: no cover
+        records = self._consumer.poll(
+            timeout_ms=int(timeout * 1000), max_records=max_messages
+        )
+        out = []
+        for batch in records.values():
+            for r in batch:
+                out.append(Message(r.value, r.offset))
+        return out
+
+    def commit(self, offset) -> None:  # pragma: no cover
+        self._consumer.commit()
+
+    def close(self) -> None:  # pragma: no cover
+        self._consumer.close()
+
+
+# -- the collector component ---------------------------------------------
+
+
+class TransportCollector(CollectorComponent):
+    """N worker threads draining a MessageSource into the Collector.
+
+    The generalization of ``KafkaCollector``/``RabbitMQCollector``/
+    ``ActiveMQCollector``: the broker specifics live in the source; the
+    decode→sample→store→commit discipline lives here, once.
+    """
+
+    def __init__(
+        self,
+        source: MessageSource,
+        collector: Collector,
+        *,
+        transport: str = "replay",
+        workers: int = 1,
+        poll_batch: int = 64,
+        poll_timeout: float = 0.2,
+    ) -> None:
+        self.source = source
+        self.collector = collector  # owns ALL metric counting
+        self.transport = transport
+        self._workers = workers
+        self._poll_batch = poll_batch
+        self._poll_timeout = poll_timeout
+        self._threads: List[threading.Thread] = []
+        self._running = threading.Event()
+        self._lock = threading.Lock()  # single-poller sources
+        # messages polled but not yet stored (storage failure): retried
+        # before the next poll so a transient rejection loses nothing
+        # in-process. Crash durability remains the committed offset.
+        self._retry: List[Message] = []
+
+    def start(self) -> "TransportCollector":
+        self._running.set()
+        for i in range(self._workers):
+            t = threading.Thread(
+                target=self._run, name=f"{self.transport}-collector-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _process(self, messages: List[Message]) -> bool:
+        """Store a batch; on storage failure stash the unstored tail for
+        retry (no in-process loss). Returns True if the batch finished."""
+        high = -1
+        for i, m in enumerate(messages):
+            try:
+                self.collector.accept_spans_bytes(m.payload)
+            except ValueError:
+                pass  # poison pill: counted dropped by the collector, skip
+            except Exception:
+                self._retry = messages[i:]  # retried before the next poll
+                if high >= 0:
+                    self.source.commit(high)
+                return False
+            high = max(high, m.offset)
+        if high >= 0:
+            self.source.commit(high)  # after accept: at-least-once
+        return True
+
+    def _poll_or_retry(self, timeout: float) -> List[Message]:
+        if self._retry:
+            messages, self._retry = self._retry, []
+            return messages
+        return self.source.poll(self._poll_batch, timeout)
+
+    def _run(self) -> None:
+        while self._running.is_set():
+            with self._lock:
+                messages = self._poll_or_retry(self._poll_timeout)
+                if messages and not self._process(messages):
+                    time.sleep(self._poll_timeout)  # back off before retry
+
+    def drain(self, deadline: float = 5.0) -> None:
+        """Test helper: poll inline until the source stops yielding."""
+        end = time.monotonic() + deadline
+        idle = 0
+        while time.monotonic() < end and idle < 3:
+            with self._lock:
+                messages = self._poll_or_retry(0.05)
+                if messages:
+                    idle = 0
+                    self._process(messages)
+                else:
+                    idle += 1
+
+    def check(self) -> CheckResult:
+        return self.source.check()
+
+    def close(self) -> None:
+        self._running.clear()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.source.close()
+
+
+def kafka_collector(
+    bootstrap_servers: str,
+    collector: Collector,
+    *,
+    topic: str = "zipkin",
+    group_id: str = "zipkin",
+    streams: int = 1,
+) -> TransportCollector:
+    """KAFKA_BOOTSTRAP_SERVERS autoconfig entry point (KafkaCollector)."""
+    return TransportCollector(
+        KafkaSource(bootstrap_servers, topic, group_id),
+        collector,
+        transport="kafka",
+        workers=streams,
+    )

@@ -59,11 +59,34 @@ def _looks_like_v1_json(data: bytes) -> bool:
 
 def _looks_like_json(data: bytes) -> bool:
     """Whitespace-tolerant JSON shape check: opens with [/{ and closes with
-    ]/} after stripping whitespace — disambiguates a leading 0x0a newline
-    from a proto3 field-1 header, which a first-byte test alone cannot."""
+    ]/} after stripping whitespace. A payload that is ALSO a structurally
+    valid proto3 frame is resolved by detect() in proto3's favor."""
     head = data[:256].lstrip(b" \t\r\n")
     tail = data[-64:].rstrip(b" \t\r\n")
     return head[:1] in (b"[", b"{") and tail[-1:] in (b"]", b"}")
+
+
+def _plausible_proto3_frame(data: bytes) -> bool:
+    """True if ``data`` is structurally a proto3 ``ListOfSpans``: repeated
+    0x0A-tagged length-delimited elements consuming the payload exactly."""
+    pos, n = 0, len(data)
+    while pos < n:
+        if data[pos] != 0x0A:
+            return False
+        pos += 1
+        # varint length
+        length, shift = 0, 0
+        while True:
+            if pos >= n or shift > 28:
+                return False
+            b = data[pos]
+            pos += 1
+            length |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        pos += length
+    return pos == n
 
 
 def detect(data: bytes) -> Encoding:
@@ -71,12 +94,21 @@ def detect(data: bytes) -> Encoding:
     if not data:
         raise ValueError("empty payload")
     first = data[0]
+    # 0x0A is ambiguous: proto3's field-1 header AND '\n'. A proto3 payload
+    # can even end in 0x7D (string tag ending in '}'), so the JSON shape
+    # check alone cannot resolve it; a structural frame walk can — a valid
+    # ListOfSpans is a sequence of 0x0A-tagged length-delimited elements
+    # consuming the payload exactly, which whitespace-padded JSON is not.
+    if first == 0x0A:
+        if _plausible_proto3_frame(data):
+            return Encoding.PROTO3
+        if _looks_like_json(data):
+            return Encoding.JSON_V1 if _looks_like_v1_json(data) else Encoding.JSON_V2
+        return Encoding.PROTO3
     if first in (0x5B, 0x7B) or (
-        first in (0x20, 0x09, 0x0D, 0x0A) and _looks_like_json(data)
+        first in (0x20, 0x09, 0x0D) and _looks_like_json(data)
     ):
         return Encoding.JSON_V1 if _looks_like_v1_json(data) else Encoding.JSON_V2
-    if first == 0x0A:
-        return Encoding.PROTO3
     if first == 0x0C:
         return Encoding.THRIFT
     raise ValueError(f"unrecognized span payload (first byte 0x{first:02x})")

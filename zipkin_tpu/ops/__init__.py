@@ -1,0 +1,16 @@
+"""Pure-JAX device ops: the TPU equivalents of the reference's hand-rolled
+hot loops (``zipkin2/internal/WriteBuffer.java``-class code, SURVEY.md §2.7).
+
+Everything here is a pure function over fixed-shape arrays, safe under
+``jax.jit`` and ``shard_map``:
+
+- :mod:`hashing` — 32-bit avalanche mixes for ids (HLL, hash joins).
+- :mod:`segments` — sorted-segment reductions (the scatter-free idiom).
+- :mod:`hll` — HyperLogLog registers with scatter-max updates.
+- :mod:`histogram` — HDR-style log2 latency histograms (exactly mergeable
+  by addition, hence ``psum``-friendly).
+- :mod:`tdigest` — merging t-digest with sort-based compaction.
+- :mod:`linker` — windowed dependency linking (parent join + ancestor
+  climb by pointer doubling), mirroring
+  ``zipkin2/internal/DependencyLinker.java``.
+"""

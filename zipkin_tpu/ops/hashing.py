@@ -1,0 +1,49 @@
+"""32-bit avalanche hashing for device-side ids.
+
+TPUs have no native 64-bit integer path worth using, so ids (trace/span ids
+are 64/128-bit hex in the model, ``zipkin2/Span.java``) travel as pairs of
+``uint32`` lanes and are mixed with murmur3's fmix32 finalizer. Used by the
+HLL sketch (trace-id cardinality) and the span-id hash joins in the device
+linker.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_C1 = jnp.uint32(0x85EBCA6B)
+_C2 = jnp.uint32(0xC2B2AE35)
+_GOLDEN = jnp.uint32(0x9E3779B9)
+
+
+def fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer: full-avalanche 32-bit mix."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * _C1
+    x = x ^ (x >> 13)
+    x = x * _C2
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash2(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Mix two u32 lanes (one 64-bit id) into one well-distributed u32."""
+    return fmix32(a.astype(jnp.uint32) ^ fmix32(b.astype(jnp.uint32) + _GOLDEN))
+
+
+def hash4(a: jnp.ndarray, b: jnp.ndarray, c: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """Mix four u32 lanes (one 128-bit id) into one u32."""
+    return hash2(hash2(a, b), hash2(c, d))
+
+
+def floor_log2(x: jnp.ndarray) -> jnp.ndarray:
+    """``floor(log2(x))`` for u32 ``x >= 1``, integer-only (f32 is not exact
+    past 2**24 so no float detour). Returns int32; 0 maps to 0."""
+    x = x.astype(jnp.uint32)
+    e = jnp.zeros(x.shape, jnp.int32)
+    for k in (16, 8, 4, 2, 1):
+        big = (x >> jnp.uint32(k)) != 0
+        e = e + jnp.where(big, k, 0).astype(jnp.int32)
+        x = jnp.where(big, x >> jnp.uint32(k), x)
+    return e

@@ -1,0 +1,104 @@
+"""HDR-style log2 latency histograms: the workhorse quantile store.
+
+Per-(service, spanName) latency distributions kept as ``uint32`` count
+arrays ``[keys, BUCKETS]``. Log2 bucketing with SUB sub-buckets per octave
+gives a bounded *relative* error of 1/(2*SUB) at every scale — the same
+guarantee HdrHistogram gives the JVM world — while being a pure
+scatter-add / segment-sum update and **exactly mergeable by addition**,
+which is what makes the cross-chip ``lax.psum`` merge correct (unlike
+t-digest, whose merge is lossy; we keep both, SURVEY.md §7).
+
+Durations are microseconds (``zipkin2/Span.java`` duration contract),
+clamped to u32 (~71 minutes) — longer spans saturate the top bucket.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from zipkin_tpu.ops.hashing import floor_log2
+
+SUB_BITS = 5
+SUB = 1 << SUB_BITS  # 32 sub-buckets per octave -> <= ~1.6% relative error
+BUCKETS = (32 - SUB_BITS + 1) * SUB  # 896
+
+
+def new_histograms(keys: int) -> jnp.ndarray:
+    return jnp.zeros((keys, BUCKETS), jnp.uint32)
+
+
+def bucket_of(duration_us: jnp.ndarray) -> jnp.ndarray:
+    """Map u32 microsecond durations to bucket indices [0, BUCKETS)."""
+    v = jnp.maximum(duration_us.astype(jnp.uint32), 0)
+    e = floor_log2(jnp.maximum(v, 1))
+    small = v < (1 << (SUB_BITS + 1))  # linear region: bucket == value
+    shift = jnp.maximum(e - SUB_BITS, 0).astype(jnp.uint32)
+    mant = (v >> shift).astype(jnp.int32) - SUB
+    idx = (e - SUB_BITS + 1) * SUB + mant
+    return jnp.where(small, v.astype(jnp.int32), idx)
+
+
+def bucket_bounds(idx: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(low, width) of each bucket in microseconds, float32."""
+    idx = idx.astype(jnp.int32)
+    small = idx < 2 * SUB
+    block = idx // SUB
+    off = idx % SUB
+    e = block + SUB_BITS - 1
+    shift = jnp.maximum(e - SUB_BITS, 0)
+    lo = ((SUB + off) << shift).astype(jnp.float32)
+    width = (jnp.int32(1) << shift).astype(jnp.float32)
+    return (
+        jnp.where(small, idx.astype(jnp.float32), lo),
+        jnp.where(small, 1.0, width),
+    )
+
+
+def update(
+    histograms: jnp.ndarray,
+    key_ids: jnp.ndarray,
+    durations_us: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """Count valid durations into ``histograms[key, bucket]``.
+
+    Invalid lanes are routed to a key clamped in range with weight 0.
+    """
+    b = bucket_of(durations_us)
+    w = valid.astype(histograms.dtype)
+    k = jnp.clip(key_ids.astype(jnp.int32), 0, histograms.shape[0] - 1)
+    return histograms.at[k, b].add(w)
+
+
+def quantile(counts: jnp.ndarray, qs: jnp.ndarray) -> jnp.ndarray:
+    """Quantiles per histogram row with linear interpolation inside the
+    bucket. ``counts``: [..., BUCKETS]; ``qs``: [Q] in [0,1].
+    Returns [..., Q] float32 (0 where the histogram is empty).
+    """
+    c = counts.astype(jnp.float32)
+    total = jnp.sum(c, axis=-1, keepdims=True)
+    cum = jnp.cumsum(c, axis=-1)
+    targets = qs[None, :] * total.reshape(-1, 1)  # [R, Q]
+    cum2 = cum.reshape(-1, BUCKETS)
+    # first bucket whose cumulative count reaches the target
+    idx = jnp.sum((cum2[:, :, None] < targets[:, None, :]), axis=1)
+    idx = jnp.clip(idx, 0, BUCKETS - 1)
+    lo, width = bucket_bounds(idx)
+    cum_before = jnp.take_along_axis(
+        jnp.concatenate([jnp.zeros_like(cum2[:, :1]), cum2], axis=1), idx, axis=1
+    )
+    in_bucket = jnp.take_along_axis(cum2, idx, axis=1) - cum_before
+    frac = jnp.where(in_bucket > 0, (targets - cum_before) / jnp.maximum(in_bucket, 1e-9), 0.5)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    out = lo + frac * width
+    out = jnp.where(total.reshape(-1, 1) > 0, out, 0.0)
+    return out.reshape(counts.shape[:-1] + (qs.shape[0],))
+
+
+def merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Exact union — addition; the psum combiner."""
+    return a + b
+
+
+def total_count(counts: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(counts.astype(jnp.uint32), axis=-1)

@@ -1,0 +1,235 @@
+"""Windowed dependency linking on device.
+
+The reference computes service dependency links two ways: online tree
+walks in ``zipkin2/internal/DependencyLinker.java`` (the InMemory path,
+SURVEY.md §3.5) or an **offline batch job** (the zipkin-dependencies Spark
+job) writing daily link tables. The TPU design follows the batch shape —
+it is the parallel-friendly one — but runs it on-device in milliseconds
+over the retained span window, so links are as fresh as the last ingest.
+
+Algorithm over a columnar span window (all arrays fixed-shape ``[n]``):
+
+1. **Parent resolution** — three sort-merge equal-joins on
+   (trace, span-id) keys replace the host's hash-map tree build:
+   a shared (server-half) span resolves its own id against non-shared
+   spans (its client half); a normal span resolves its ``parentId``
+   preferring the shared rendition (the server half is the closer tree
+   node, matching ``zipkin2/internal/SpanNode.java``'s index preference),
+   falling back to non-shared. Each join is one lexsort of the union +
+   a per-run max — no data-dependent control flow.
+2. **has-child** marks (scatter-max) implement rule 1 of the linker
+   (a CLIENT span with children defers to its server half).
+3. **Nearest RPC ancestor** by pointer doubling: ``jump[i]`` points to the
+   nearest ancestor-or-self with a kind; squaring it ``ITERS`` times
+   resolves chains up to depth ``2**ITERS`` in O(log depth) passes —
+   the device analog of ``_find_rpc_ancestor``'s while-loop.
+4. **Rule application** is a pure vectorized select emitting up to two
+   edges per span (main + rule-6b backfill), then a scatter-add into the
+   ``[services, services]`` call/error matrices — which merge across
+   shards by ``psum``.
+
+Parity with the host oracle is asserted span-for-span in
+tests/test_ops_linker.py over the DependencyLinkerTest edge-case matrix.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zipkin_tpu.ops.segments import segment_starts
+
+# pointer-doubling passes: resolves ancestor chains up to depth 2**ITERS
+ITERS = 7
+
+KIND_NONE, KIND_CLIENT, KIND_SERVER, KIND_PRODUCER, KIND_CONSUMER = range(5)
+
+
+class LinkInput(NamedTuple):
+    """Columnar span window (see zipkin_tpu.tpu.columnar.SpanColumns)."""
+
+    trace_h: jnp.ndarray  # u32 hash of the full 128-bit trace id
+    tl0: jnp.ndarray  # u32 low lanes of the trace id (join key lanes)
+    tl1: jnp.ndarray
+    s0: jnp.ndarray  # u32 span id lanes
+    s1: jnp.ndarray
+    p0: jnp.ndarray  # u32 parent id lanes (0,0 = absent)
+    p1: jnp.ndarray
+    shared: jnp.ndarray  # bool — server half of a shared-id RPC pair
+    kind: jnp.ndarray  # i32 KIND_*
+    svc: jnp.ndarray  # i32 local service id (0 = unknown)
+    rsvc: jnp.ndarray  # i32 remote service id (0 = unknown)
+    err: jnp.ndarray  # bool — span has an "error" tag
+    valid: jnp.ndarray  # bool — lane holds a live span
+
+
+def _run_max(values: jnp.ndarray, key_lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Per-run max of ``values`` over runs of equal composite keys (sorted)."""
+    change = jnp.zeros(values.shape[0], bool).at[0].set(True)
+    for lane in key_lanes:
+        change = change | jnp.asarray(segment_starts(lane))
+    run_id = jnp.cumsum(change.astype(jnp.int32)) - 1
+    seg = jnp.full(values.shape[0], -1, values.dtype).at[run_id].max(values)
+    return seg[run_id]
+
+
+def _equal_join(
+    table_keys: Sequence[jnp.ndarray],
+    table_valid: jnp.ndarray,
+    query_keys: Sequence[jnp.ndarray],
+    query_valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """For each query lane, the index of *a* valid table lane whose composite
+    key (u32 lanes) equals the query's, else -1. One lexsort of the union.
+    """
+    n = table_valid.shape[0]
+    lanes = []
+    for t, q in zip(table_keys, query_keys):
+        lanes.append(jnp.concatenate([t.astype(jnp.uint32), q.astype(jnp.uint32)]))
+    # invalid lanes get a key of all-ones so they cluster harmlessly at the end
+    anyvalid = jnp.concatenate([table_valid, query_valid])
+    lanes = [jnp.where(anyvalid, l, jnp.uint32(0xFFFFFFFF)) for l in lanes]
+
+    value = jnp.concatenate(
+        [
+            jnp.where(table_valid, jnp.arange(n, dtype=jnp.int32), -1),
+            jnp.full((n,), -1, jnp.int32),
+        ]
+    )
+    # lexsort: last key is primary; order within equal keys is irrelevant
+    # because _run_max scans the whole run.
+    order = jnp.lexsort(tuple(lanes))
+    matched = _run_max(value[order], [l[order] for l in lanes])
+    # scatter back to original positions
+    unsorted = jnp.zeros(2 * n, jnp.int32).at[order].set(matched)
+    result = unsorted[n:]
+    return jnp.where(query_valid, result, -1)
+
+
+def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Tree edges from id joins: returns (parent_row [n] with -1 for roots,
+    has_child [n] bool)."""
+    n = x.valid.shape[0]
+    trace = (x.trace_h, x.tl0, x.tl1)
+    has_parent = ((x.p0 | x.p1) != 0) & x.valid
+    nonshared = x.valid & ~x.shared
+    sharedv = x.valid & x.shared
+
+    own_key = trace + (x.s0, x.s1)
+    parent_key = trace + (x.p0, x.p1)
+
+    # shared server half -> its client half (same id, non-shared)
+    j_shared = _equal_join(own_key, nonshared, own_key, sharedv)
+    # normal span -> parent id, preferring the shared rendition
+    j_to_shared = _equal_join(own_key, sharedv, parent_key, nonshared & has_parent)
+    j_to_normal = _equal_join(own_key, nonshared, parent_key, nonshared & has_parent)
+    # a span must not become its own parent (self-parent == root)
+    self_idx = jnp.arange(n, dtype=jnp.int32)
+    j_to_normal = jnp.where(j_to_normal == self_idx, -1, j_to_normal)
+
+    parent = jnp.where(
+        sharedv, j_shared, jnp.where(j_to_shared >= 0, j_to_shared, j_to_normal)
+    )
+    parent = jnp.where(x.valid, parent, -1)
+
+    has_child = (
+        jnp.zeros(n, jnp.int32)
+        .at[jnp.where(parent >= 0, parent, 0)]
+        .max(jnp.where(parent >= 0, 1, 0))
+    )
+    return parent, has_child.astype(bool)
+
+
+def nearest_rpc_ancestor(
+    parent: jnp.ndarray, kind: jnp.ndarray
+) -> jnp.ndarray:
+    """Row index of the nearest strict ancestor with a kind, else -1.
+
+    Pointer doubling with a sentinel row ``n`` standing in for "none".
+    """
+    n = parent.shape[0]
+    sent = n
+    par = jnp.where(parent >= 0, parent, sent)
+    kind_ext = jnp.concatenate([kind, jnp.zeros((1,), kind.dtype)])
+    par_ext = jnp.concatenate([par, jnp.full((1,), sent, par.dtype)])
+
+    # jump[i] = i if span i has a kind, else its parent (toward the root)
+    jump = jnp.where(kind_ext != 0, jnp.arange(n + 1), par_ext)
+    jump = jump.at[sent].set(sent)
+    for _ in range(ITERS):
+        jump = jump[jump]
+
+    anc = jump[par]  # start the walk at the parent (strict ancestor)
+    anc = jnp.where(anc == sent, -1, anc)
+    # if the chain ended on a kindless root, there is no RPC ancestor
+    anc = jnp.where((anc >= 0) & (kind_ext[jnp.where(anc >= 0, anc, 0)] != 0), anc, -1)
+    return anc
+
+
+def link_window(
+    x: LinkInput, num_services: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dependency links over one span window.
+
+    Returns (calls, errors) — ``[num_services, num_services]`` uint32
+    matrices indexed by interned service id (0 = unknown; row/col 0 is
+    never emitted). Merge across shards/windows by addition (psum).
+    """
+    n = x.valid.shape[0]
+    parent, has_child = resolve_parents(x)
+    anc = nearest_rpc_ancestor(parent, jnp.where(x.valid, x.kind, 0))
+    anc_svc = jnp.where(anc >= 0, x.svc[jnp.where(anc >= 0, anc, 0)], 0)
+
+    local, remote = x.svc, x.rsvc
+    kind = x.kind
+
+    # rule 1: client span with children defers to its server half
+    live = x.valid & ~((kind == KIND_CLIENT) & has_child)
+    # rule 2: kindless spans with both sides known act like clients
+    keff = jnp.where(
+        (kind == KIND_NONE) & (local > 0) & (remote > 0), KIND_CLIENT, kind
+    )
+    live = live & (keff != KIND_NONE)
+
+    is_server_like = (keff == KIND_SERVER) | (keff == KIND_CONSUMER)
+    par_svc = jnp.where(is_server_like, remote, local)
+    child_svc = jnp.where(is_server_like, local, remote)
+
+    # rule 3: root server with unknown caller
+    live = live & ~((keff == KIND_SERVER) & (parent < 0) & (remote == 0))
+
+    is_messaging = (keff == KIND_PRODUCER) | (keff == KIND_CONSUMER)
+    # rule 5: messaging needs both sides known, no tree walk through brokers
+    live = live & ~(is_messaging & ((par_svc == 0) | (child_svc == 0)))
+
+    # rule 6: RPC spans resolve the parent via the nearest RPC ancestor
+    is_rpc = (keff == KIND_CLIENT) | (keff == KIND_SERVER)
+    use_anc = is_rpc & (anc_svc > 0) & ((keff == KIND_SERVER) | (par_svc == 0))
+    par_svc = jnp.where(use_anc, anc_svc, par_svc)
+
+    main_ok = live & (par_svc > 0) & (child_svc > 0)
+    main_err = main_ok & x.err
+
+    # rule 6b: client whose service differs from its RPC ancestor implies an
+    # uninstrumented hop — backfill ancestor->client (never an error)
+    back_ok = (
+        live
+        & (keff == KIND_CLIENT)
+        & (local > 0)
+        & (anc_svc > 0)
+        & (anc_svc != local)
+    )
+
+    s = num_services
+    calls = jnp.zeros((s, s), jnp.uint32)
+    errors = jnp.zeros((s, s), jnp.uint32)
+    pc = jnp.clip(par_svc, 0, s - 1)
+    cc = jnp.clip(child_svc, 0, s - 1)
+    calls = calls.at[pc, cc].add(main_ok.astype(jnp.uint32))
+    errors = errors.at[pc, cc].add(main_err.astype(jnp.uint32))
+    bc = jnp.clip(anc_svc, 0, s - 1)
+    lc = jnp.clip(local, 0, s - 1)
+    calls = calls.at[bc, lc].add(back_ok.astype(jnp.uint32))
+    return calls, errors

@@ -1,0 +1,62 @@
+"""Sorted-segment reductions — the TPU idiom replacing scatter contention.
+
+Many spans in one batch hit the same (service, spanName) key; raw
+scatter-adds serialize on those collisions. The XLA-friendly pattern
+(SURVEY.md §7 hard-part 3) is: sort by key once, then do segment sums /
+cumulative sums over the sorted runs, which lower to fast scans.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_starts(sorted_ids: jnp.ndarray) -> jnp.ndarray:
+    """Boolean mask marking the first element of each run in sorted ids."""
+    first = jnp.ones((1,) + sorted_ids.shape[1:], dtype=bool)
+    return jnp.concatenate([first, sorted_ids[1:] != sorted_ids[:-1]], axis=0)
+
+
+def run_start_indices(sorted_ids: jnp.ndarray) -> jnp.ndarray:
+    """For each element, the index where its run of equal ids begins."""
+    idx = jnp.arange(sorted_ids.shape[0])
+    start_idx = jnp.where(segment_starts(sorted_ids), idx, 0)
+    return jax.lax.associative_scan(jnp.maximum, start_idx)
+
+
+def sorted_segment_cumsum(values: jnp.ndarray, sorted_ids: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive cumulative sum within each run of equal sorted ids.
+
+    Two scans, no scatter: subtract from the global inclusive cumsum the
+    global *exclusive* cumsum at each element's run start.
+    """
+    cum = jnp.cumsum(values, axis=0)
+    excl = cum - values
+    return cum - excl[run_start_indices(sorted_ids)]
+
+
+def sorted_segment_total(values: jnp.ndarray, sorted_ids: jnp.ndarray) -> jnp.ndarray:
+    """For each element, the total of its run (broadcast segment sum)."""
+    cum = sorted_segment_cumsum(values, sorted_ids)
+    # run total = cumsum at the run's last element; the last element of run r
+    # is the element before the next run's start (or the final element).
+    n = values.shape[0]
+    starts = segment_starts(sorted_ids)
+    # index of the run end for each element: scan run-start indices from the
+    # right — the next start minus one.
+    idx = jnp.arange(n)
+    next_start = jnp.where(starts, idx, n)
+    next_start = jax.lax.associative_scan(jnp.minimum, next_start, reverse=True)
+    # next_start here is the start of MY run scanned from the right; shift to
+    # find the start of the NEXT run instead:
+    nxt = jnp.concatenate([next_start[1:], jnp.full((1,), n, next_start.dtype)])
+    return cum[nxt - 1]
+
+
+def segment_sum_scatter(
+    values: jnp.ndarray, ids: jnp.ndarray, num_segments: int
+) -> jnp.ndarray:
+    """Plain scatter-add segment sum (ids need not be sorted)."""
+    out = jnp.zeros((num_segments,) + values.shape[1:], values.dtype)
+    return out.at[ids].add(values)

@@ -1,0 +1,125 @@
+"""Merging t-digest with sort-based compaction, vectorized over key slots.
+
+BASELINE's north star names t-digest as the per-(service, spanName)
+percentile sketch. The classic implementation is pointer-chasing
+(insertion buffers + centroid lists) — hostile to XLA. This one is the
+*merging digest* formulation recast as fixed-shape array ops, the TPU-first
+design (SURVEY.md §7 hard-part 2):
+
+1. flatten existing centroids [U, C, 2] and the incoming (slot, value,
+   weight) triples into one point list;
+2. one lexsort by (slot, mean) — sorts are XLA-friendly;
+3. within-slot cumulative weights -> quantile position q of each point;
+4. cluster id via the k1 scale function (arcsin), which concentrates
+   cluster resolution at the tails;
+5. segment-sum (weight, weight*mean) by (slot, cluster) -> new centroids.
+
+Every step is static-shape; the whole update jits to sort + scans +
+one scatter-add. Cross-shard reads merge by concatenating centroid lists
+and re-compacting (:func:`merge`).
+
+Accuracy: with C=64 centroids, tail quantiles (p99) land within ~0.5% of
+exact on 1M-point streams (see tests/test_ops_tdigest.py), comfortably
+inside BASELINE config[1]'s epsilon.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zipkin_tpu.ops.segments import sorted_segment_cumsum, sorted_segment_total
+
+
+def new_digests(slots: int, centroids: int = 64) -> jnp.ndarray:
+    """Zeroed digest state: [slots, centroids, 2] (mean, weight) float32."""
+    return jnp.zeros((slots, centroids, 2), jnp.float32)
+
+
+def _cluster_ids(q: jnp.ndarray, c: int) -> jnp.ndarray:
+    """k1 scale function: cluster = floor(C * (asin(2q-1)/pi + 1/2))."""
+    x = jnp.clip(2.0 * q - 1.0, -1.0, 1.0)
+    k = jnp.arcsin(x) / jnp.pi + 0.5
+    return jnp.clip((k * c).astype(jnp.int32), 0, c - 1)
+
+
+def update(
+    digests: jnp.ndarray,
+    slot_ids: jnp.ndarray,
+    values: jnp.ndarray,
+    weights: jnp.ndarray,
+) -> jnp.ndarray:
+    """Merge a batch of weighted values into their slots' digests.
+
+    ``slot_ids`` int32 in [0, slots); lanes with weight 0 are inert (point
+    them at slot 0). Returns digests of the same shape.
+    """
+    u, c, _ = digests.shape
+    st_mean = digests[..., 0].reshape(-1)
+    st_w = digests[..., 1].reshape(-1)
+    st_slot = jnp.repeat(jnp.arange(u, dtype=jnp.int32), c)
+
+    mean = jnp.concatenate([st_mean, values.astype(jnp.float32)])
+    w = jnp.concatenate([st_w, weights.astype(jnp.float32)])
+    slot = jnp.concatenate([st_slot, slot_ids.astype(jnp.int32)])
+
+    # empty centroids / inert lanes: push to +inf so they sort to the slot
+    # tail and contribute weight 0 everywhere.
+    mean = jnp.where(w > 0, mean, jnp.inf)
+
+    order = jnp.lexsort((mean, slot))
+    mean, w, slot = mean[order], w[order], slot[order]
+
+    cum = sorted_segment_cumsum(w, slot)
+    total = sorted_segment_total(w, slot)
+    q = jnp.where(total > 0, (cum - 0.5 * w) / jnp.maximum(total, 1e-9), 0.0)
+    cluster = _cluster_ids(q, c)
+
+    dest = slot * c + cluster
+    wsum = jnp.zeros((u * c,), jnp.float32).at[dest].add(w)
+    msum = jnp.zeros((u * c,), jnp.float32).at[dest].add(
+        w * jnp.where(jnp.isfinite(mean), mean, 0.0)
+    )
+    new_mean = jnp.where(wsum > 0, msum / jnp.maximum(wsum, 1e-9), 0.0)
+    return jnp.stack([new_mean, wsum], axis=-1).reshape(u, c, 2)
+
+
+def quantile(digests: jnp.ndarray, qs: jnp.ndarray) -> jnp.ndarray:
+    """Quantiles per slot: [slots, Q] float32, 0 for empty slots.
+
+    Standard t-digest interpolation: centroid means at cumulative-weight
+    midpoints, linear in between.
+    """
+    means = digests[..., 0]
+    ws = digests[..., 1]
+    # centroids are mean-sorted by construction; make x strictly usable for
+    # interp by masking empties to the running max.
+    cum = jnp.cumsum(ws, axis=-1) - 0.5 * ws
+    total = jnp.sum(ws, axis=-1, keepdims=True)
+    x = jnp.where(ws > 0, means, -jnp.inf)
+    x = jax.lax.associative_scan(jnp.maximum, x, axis=-1)
+    x = jnp.where(jnp.isfinite(x), x, 0.0)
+
+    targets = qs[None, :] * total  # [slots, Q]
+
+    def one(cum_row, x_row, t_row):
+        return jnp.interp(t_row, cum_row, x_row)
+
+    out = jax.vmap(one)(cum, x, targets)
+    return jnp.where(total > 0, out, 0.0)
+
+
+def merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Merge two digest states slot-wise by re-compaction."""
+    u, c, _ = a.shape
+    slot = jnp.repeat(jnp.arange(u, dtype=jnp.int32), c)
+    return update(a, slot, b[..., 0].reshape(-1), b[..., 1].reshape(-1))
+
+
+def merge_many(states: np.ndarray) -> jnp.ndarray:
+    """Merge [shards, U, C, 2] into one [U, C, 2] (read-path host helper)."""
+    acc = jnp.asarray(states[0])
+    for s in states[1:]:
+        acc = merge(acc, jnp.asarray(s))
+    return acc

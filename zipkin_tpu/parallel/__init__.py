@@ -1,0 +1,15 @@
+"""Multi-chip scale-out: mesh construction, trace-affine routing, and
+``shard_map``-based SPMD over the aggregate state.
+
+The reference scales by stateless server fan-out + storage sharding
+(Cassandra token ring / ES shards, SURVEY.md §2.8). The TPU equivalent:
+spans are routed host-side by trace hash to a shard (trace affinity makes
+parent joins shard-local), each shard folds its sub-batch with the same
+pure ingest step, and reads merge shard states with XLA collectives over
+ICI (``psum`` for histograms/edges, ``pmax`` for HLL) — never NCCL/MPI.
+"""
+
+from zipkin_tpu.parallel.mesh import SHARD_AXIS, make_mesh
+from zipkin_tpu.parallel.sharded import ShardedAggregator
+
+__all__ = ["SHARD_AXIS", "make_mesh", "ShardedAggregator"]

@@ -1,0 +1,32 @@
+"""Device mesh construction for the aggregation tier.
+
+One logical axis, ``shard``: span-hash data parallelism (SURVEY.md §2.8).
+A second axis is deliberately absent — every cross-shard interaction is a
+commutative sketch merge, so a flat ring over ICI is the whole topology.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all local devices)."""
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))

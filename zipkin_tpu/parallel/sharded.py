@@ -1,0 +1,176 @@
+"""ShardedAggregator: the SPMD aggregate tier over a device mesh.
+
+State lives as one pytree with a leading ``[shards, ...]`` axis sharded
+over the mesh; ingest is ``shard_map`` of the pure single-shard step;
+reads merge with ``psum``/``pmax`` over ICI (SURVEY.md §2.8 mapping
+table). Runs identically on one real TPU chip (mesh of 1), a v5e-8, or
+the 8-virtual-device CPU backend used in CI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zipkin_tpu.ops import linker as dlink
+from zipkin_tpu.tpu import ingest as ing
+from zipkin_tpu.tpu.columnar import SpanColumns, empty_columns
+from zipkin_tpu.tpu.state import AggConfig, AggState, init_state
+
+SHARD_AXIS = "shard"
+
+
+def route_columns(
+    cols: SpanColumns, n_shards: int, pad_to_multiple: int = 256
+) -> SpanColumns:
+    """Host-side trace-affine routing: split one batch into ``n_shards``
+    stacked sub-batches ``[shards, per]`` keyed by trace hash.
+
+    Trace affinity (all spans of a trace land on one shard) is what makes
+    the dependency-link parent joins shard-local — the same invariant the
+    reference gets from trace-id–keyed storage partitioning.
+    """
+    shard_of = (cols.trace_h % np.uint32(n_shards)).astype(np.int64)
+    shard_of = np.where(cols.valid, shard_of, -1)
+    counts = [int((shard_of == d).sum()) for d in range(n_shards)]
+    per = max(counts + [1])
+    per = ((per + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
+    out = [empty_columns(per) for _ in range(n_shards)]
+    for d in range(n_shards):
+        idx = np.nonzero(shard_of == d)[0]
+        for field, dst in zip(cols, out[d]):
+            dst[: len(idx)] = field[idx]
+    return SpanColumns(*(np.stack([o[i] for o in out]) for i in range(len(cols))))
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_programs(config: AggConfig, mesh: Mesh):
+    """Compiled SPMD programs shared by every aggregator with the same
+    (config, mesh) — constructing a store must not trigger recompiles."""
+    n_shards = int(np.prod(mesh.devices.shape))
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    template = jax.eval_shape(lambda: init_state(config))
+
+    def _init() -> AggState:
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((n_shards,) + a.shape, a.dtype), template
+        )
+
+    init = jax.jit(_init, out_shardings=sharding)
+
+    one = functools.partial(ing.ingest_step, config)
+
+    def spmd_step(state: AggState, batch: SpanColumns) -> AggState:
+        squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+        expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+        return expand(one(squeeze(state), squeeze(batch)))
+
+    step = jax.jit(
+        shard_map(
+            spmd_step,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=P(SHARD_AXIS),
+        ),
+        donate_argnums=(0,),
+    )
+
+    def spmd_links(state: AggState, ts_lo, ts_hi):
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi)
+        return jax.lax.psum(calls, SHARD_AXIS), jax.lax.psum(errors, SHARD_AXIS)
+
+    links = jax.jit(
+        shard_map(
+            spmd_links,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(), P()),
+            out_specs=P(),
+        )
+    )
+
+    def spmd_merge(state: AggState):
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        return (
+            jax.lax.psum(s.hist, SHARD_AXIS),
+            jax.lax.pmax(s.hll, SHARD_AXIS),
+            jax.lax.psum(s.counters, SHARD_AXIS),
+        )
+
+    merge = jax.jit(
+        shard_map(spmd_merge, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
+    )
+    return init, step, links, merge, sharding
+
+
+class ShardedAggregator:
+    """Owns the sharded state and the compiled SPMD update/read programs."""
+
+    def __init__(self, config: AggConfig, mesh: Optional[Mesh] = None) -> None:
+        if mesh is None:
+            from zipkin_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh()
+        self.config = config
+        self.mesh = mesh
+        self.n_shards = int(np.prod(mesh.devices.shape))
+        init, self._step, self._links, self._merge, self._sharding = (
+            _compiled_programs(config, mesh)
+        )
+        self.state: AggState = init()
+        # Exact host-side counters: the device counters are u32 and wrap
+        # after ~4.3B spans (~72 min at the north-star rate); these are the
+        # source of truth for the API and snapshot resume markers.
+        self.host_counters = {
+            "spans": 0,
+            "spansWithDuration": 0,
+            "spansWithError": 0,
+            "batches": 0,
+        }
+
+    # -- write path ------------------------------------------------------
+
+    def ingest(self, cols: SpanColumns) -> None:
+        """Route one host batch across shards and fold it in."""
+        if self.n_shards == 1:
+            routed = SpanColumns(*(f[None] for f in cols))
+        else:
+            routed = route_columns(cols, self.n_shards)
+        device_batch = jax.device_put(routed, self._sharding)
+        self.state = self._step(self.state, device_batch)
+        c = self.host_counters
+        c["spans"] += int(cols.valid.sum())
+        c["spansWithDuration"] += int((cols.valid & cols.has_dur).sum())
+        c["spansWithError"] += int((cols.valid & cols.err).sum())
+        c["batches"] += 1
+
+    # -- read path (merged across shards over ICI) -----------------------
+
+    def merged_sketches(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(hist [K,B], hll [S+1,m], counters) merged over all shards."""
+        hist, hll_regs, counters = self._merge(self.state)
+        return np.asarray(hist), np.asarray(hll_regs), np.asarray(counters)
+
+    def dependency_matrices(
+        self, ts_lo_min: int, ts_hi_min: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        calls, errors = self._links(
+            self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
+        )
+        return np.asarray(calls), np.asarray(errors)
+
+    def merged_digest(self) -> jnp.ndarray:
+        """[K, C, 2] t-digest merged across shards (host-side compaction)."""
+        from zipkin_tpu.ops import tdigest
+
+        stacked = np.asarray(self.state.digest)  # [D, K, C, 2]
+        return tdigest.merge_many(stacked)
+
+    def block_until_ready(self) -> None:
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), self.state)

@@ -40,11 +40,16 @@ from zipkin_tpu.model.codec import Encoding
 from zipkin_tpu.server.config import ServerConfig
 from zipkin_tpu.storage.memory import InMemoryStorage
 from zipkin_tpu.storage.spi import QueryRequest, StorageComponent
+from zipkin_tpu.storage.throttle import RejectedExecutionError
 from zipkin_tpu.utils.component import Component
 
 logger = logging.getLogger(__name__)
 
 JSON = "application/json"
+
+
+class PayloadTooLarge(ValueError):
+    """Inflated request body exceeded the decompression cap."""
 
 
 def build_storage(config: ServerConfig) -> StorageComponent:
@@ -112,6 +117,12 @@ class ZipkinServer:
         r.add_get("/api/v2/dependencies", self.get_dependencies)
         r.add_get("/api/v2/autocompleteKeys", self.get_autocomplete_keys)
         r.add_get("/api/v2/autocompleteValues", self.get_autocomplete_values)
+        if hasattr(self.storage, "latency_quantiles"):
+            # TPU aggregation tier extensions (sketch-served reads)
+            r.add_get("/api/v2/tpu/percentiles", self.get_tpu_percentiles)
+            r.add_get("/api/v2/tpu/cardinalities", self.get_tpu_cardinalities)
+            r.add_get("/api/v2/tpu/counters", self.get_tpu_counters)
+            r.add_post("/api/v2/tpu/snapshot", self.post_tpu_snapshot)
         r.add_get("/health", self.get_health)
         r.add_get("/info", self.get_info)
         r.add_get("/metrics", self.get_metrics)
@@ -135,12 +146,31 @@ class ZipkinServer:
 
     # -- ingest ------------------------------------------------------------
 
+    MAX_INFLATED = 256 * 1024 * 1024  # decompression-bomb guard
+
     async def _read_body(self, request: web.Request) -> bytes:
         # aiohttp transparently inflates Content-Encoding: gzip; the magic
-        # check also covers clients that compress without the header.
+        # check also covers clients that compress without the header. Inflate
+        # incrementally with a size cap: client_max_size only bounds the
+        # COMPRESSED bytes, so a gzip bomb must not materialize unbounded.
         body = await request.read()
         if body[:2] == b"\x1f\x8b":
-            body = gzip.decompress(body)
+            import zlib
+
+            chunks: List[bytes] = []
+            total = 0
+            remaining = body
+            while remaining:  # multi-member gzip is valid per RFC 1952
+                d = zlib.decompressobj(wbits=31)
+                out = d.decompress(remaining, self.MAX_INFLATED - total)
+                total += len(out)
+                if d.unconsumed_tail:
+                    raise PayloadTooLarge(
+                        f"gzip payload inflates past {self.MAX_INFLATED} bytes"
+                    )
+                chunks.append(out)
+                remaining = d.unused_data
+            body = b"".join(chunks)
         return body
 
     async def post_spans_v2(self, request: web.Request) -> web.Response:
@@ -152,6 +182,8 @@ class ZipkinServer:
     async def _ingest(self, request: web.Request, *, v1: bool) -> web.Response:
         try:
             body = await self._read_body(request)
+        except PayloadTooLarge as e:
+            return web.Response(status=413, text=str(e))
         except Exception:
             return web.Response(status=400, text="cannot gunzip body")
         ctype = request.headers.get("Content-Type", "").split(";")[0].strip()
@@ -167,6 +199,10 @@ class ZipkinServer:
             await asyncio.to_thread(self.collector.accept_spans_bytes, body, encoding)
         except ValueError as e:
             return web.Response(status=400, text=str(e))
+        except RejectedExecutionError as e:
+            # storage throttle shed the write: tell the sender to back off
+            # (reference behavior for RejectedExecutionException)
+            return web.Response(status=503, text=str(e))
         return web.Response(status=202)
 
     # -- query -------------------------------------------------------------
@@ -283,6 +319,47 @@ class ZipkinServer:
             lambda: self.storage.autocomplete_tags().get_values(key).execute()
         )
         return web.json_response(values)
+
+    # -- TPU aggregation tier extensions -----------------------------------
+    # Not part of the reference HTTP surface: these serve the sketch reads
+    # the BASELINE north star adds (latency percentiles, trace cardinality)
+    # straight from device state. The Lens-compatible endpoints above stay
+    # byte-compatible; these are additive under /api/v2/tpu/.
+
+    async def get_tpu_percentiles(self, request: web.Request) -> web.Response:
+        raw_q = request.query.get("q", "0.5,0.9,0.99")
+        try:
+            qs = [float(x) for x in raw_q.split(",") if x]
+            if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
+                raise ValueError(f"q out of range: {raw_q!r}")
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        rows = await asyncio.to_thread(
+            self.storage.latency_quantiles,
+            qs,
+            request.query.get("serviceName"),
+            request.query.get("spanName"),
+            request.query.get("sketch", "digest") == "digest",
+        )
+        return web.json_response(rows)
+
+    async def get_tpu_cardinalities(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.storage.trace_cardinalities)
+        )
+
+    async def get_tpu_counters(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            await asyncio.to_thread(self.storage.ingest_counters)
+        )
+
+    async def post_tpu_snapshot(self, request: web.Request) -> web.Response:
+        if not hasattr(self.storage, "snapshot"):
+            return web.Response(status=501, text="storage does not snapshot")
+        path = await asyncio.to_thread(self.storage.snapshot)
+        if path is None:
+            return web.Response(status=409, text="no checkpoint_dir configured")
+        return web.json_response({"snapshot": path})
 
     # -- ops ---------------------------------------------------------------
 

@@ -77,6 +77,14 @@ class ThrottledStorage(StorageComponent):
     def _wrap(self, call: Call) -> Call:
         return _ThrottledCall(call, self._throttle)
 
+    def __getattr__(self, name: str):
+        # Forward non-SPI extensions (e.g. the TPU tier's latency_quantiles /
+        # trace_cardinalities / ingest_counters / snapshot) so wrapping a
+        # storage in the throttle doesn't hide its extra read surface.
+        if name == "delegate":  # not yet set during __init__
+            raise AttributeError(name)
+        return getattr(self.delegate, name)
+
     def span_consumer(self) -> SpanConsumer:
         inner = self.delegate.span_consumer()
         outer = self

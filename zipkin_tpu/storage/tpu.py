@@ -1,0 +1,58 @@
+"""STORAGE_TYPE=tpu — the autoconfig-facing adapter over the device tier.
+
+Mirrors the per-backend autoconfig pattern of the reference server
+(``zipkin-server/.../internal/{cassandra3,elasticsearch,...}``, SURVEY.md
+§2.4): this module maps flat server config knobs onto the core
+:class:`zipkin_tpu.tpu.store.TpuStorage` construction (mesh selection,
+archive bound, checkpoint wiring).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage as _CoreTpuStorage
+
+
+class TpuStorage(_CoreTpuStorage):
+    def __init__(
+        self,
+        *,
+        max_span_count: int = 500_000,
+        batch_size: int = 8192,
+        num_devices: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        config: Optional[AggConfig] = None,
+        strict_trace_id: bool = True,
+        search_enabled: bool = True,
+        autocomplete_keys: Sequence[str] = (),
+    ) -> None:
+        mesh = None
+        if num_devices is not None:
+            from zipkin_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(num_devices)
+        super().__init__(
+            config=config,
+            mesh=mesh,
+            strict_trace_id=strict_trace_id,
+            search_enabled=search_enabled,
+            autocomplete_keys=autocomplete_keys,
+            archive_max_span_count=max_span_count,
+            pad_to_multiple=min(batch_size, 1024),
+        )
+        self.batch_size = batch_size
+        self.checkpoint_dir = checkpoint_dir
+        if checkpoint_dir:
+            from zipkin_tpu.tpu.snapshot import maybe_restore
+
+            maybe_restore(self, checkpoint_dir)
+
+    def snapshot(self) -> Optional[str]:
+        """Persist device sketch state (see tpu/snapshot.py); returns path."""
+        if not self.checkpoint_dir:
+            return None
+        from zipkin_tpu.tpu.snapshot import save
+
+        return save(self, self.checkpoint_dir)

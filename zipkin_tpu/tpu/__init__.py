@@ -1,0 +1,8 @@
+"""The TPU aggregation tier: columnar span batches, device sketch state,
+the jit'd ingest step, and the storage SPI implementation backed by them.
+
+This package is the "new thing" the rebuild adds over the reference
+(BASELINE north star): a ``zipkin-storage-tpu`` equivalent where span
+batches stream into JAX arrays and aggregates (latency digests, HLL
+cardinalities, dependency links) are maintained on-device.
+"""

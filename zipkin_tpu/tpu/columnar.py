@@ -1,0 +1,244 @@
+"""Host-side columnar packing: Span objects -> fixed-shape device batches.
+
+The reference's row-oriented object-per-span design
+(``zipkin2/Span.java``) is wrong for TPU; the idiomatic core is a struct
+of fixed-shape arrays with host-side string interning (SURVEY.md §7
+"Design stance"). This module is the boundary: everything above it speaks
+:class:`zipkin_tpu.model.span.Span`, everything below speaks arrays.
+
+Ids: trace/span ids are 64/128-bit hex strings in the model; on device
+they travel as ``uint32`` lane pairs (TPUs have no useful 64-bit integer
+path). ``trace_h`` is a host-computed 32-bit avalanche hash of the full
+128-bit id, used for HLL cardinality and as the cheap first lane of
+join keys.
+
+Strings: service names / span names are interned into bounded
+vocabularies. Id 0 is reserved for "unknown/absent"; overflow beyond
+capacity lands in id 0 and is counted (the bounded-cardinality stance the
+reference delegates to backends, SURVEY.md §5 long-context row).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from zipkin_tpu.internal.hex import lower_64, normalize_trace_id
+from zipkin_tpu.model.span import Kind, Span
+
+KIND_TO_ID = {
+    None: 0,
+    Kind.CLIENT: 1,
+    Kind.SERVER: 2,
+    Kind.PRODUCER: 3,
+    Kind.CONSUMER: 4,
+}
+ID_TO_KIND = {v: k for k, v in KIND_TO_ID.items()}
+
+_U32 = np.uint32
+_MASK32 = 0xFFFFFFFF
+
+
+def _mix32(x: np.ndarray) -> np.ndarray:
+    """numpy mirror of zipkin_tpu.ops.hashing.fmix32 (must stay in sync)."""
+    x = x.astype(np.uint32)
+    x ^= x >> _U32(16)
+    x = (x.astype(np.uint64) * np.uint64(0x85EBCA6B)).astype(np.uint32)
+    x ^= x >> _U32(13)
+    x = (x.astype(np.uint64) * np.uint64(0xC2B2AE35)).astype(np.uint32)
+    x ^= x >> _U32(16)
+    return x
+
+
+def _hash2_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return _mix32(a.astype(np.uint32) ^ _mix32((b.astype(np.uint64) + np.uint64(0x9E3779B9)).astype(np.uint32)))
+
+
+class Interner:
+    """Bounded, thread-safe string -> dense id map. Id 0 is reserved."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._ids: Dict[str, int] = {}
+        self._names: List[str] = ["" ]  # id 0
+        self._overflow = 0
+        self._lock = threading.Lock()
+
+    def intern(self, name: Optional[str]) -> int:
+        if not name:
+            return 0
+        with self._lock:
+            got = self._ids.get(name)
+            if got is not None:
+                return got
+            if len(self._names) >= self.capacity:
+                self._overflow += 1
+                return 0
+            nid = len(self._names)
+            self._ids[name] = nid
+            self._names.append(name)
+            return nid
+
+    def lookup(self, nid: int) -> str:
+        return self._names[nid] if 0 <= nid < len(self._names) else ""
+
+    def get(self, name: str) -> Optional[int]:
+        return self._ids.get(name)
+
+    @property
+    def names(self) -> List[str]:
+        return self._names[1:]
+
+    @property
+    def overflow(self) -> int:
+        return self._overflow
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+
+class Vocab:
+    """The interners one TPU store shares across batches.
+
+    ``keys`` interns (service, spanName) pairs — the sketch row space for
+    latency digests, mirroring the per-(service, span) indexing of the
+    reference's index tables (``trace_by_service_span`` in the cassandra
+    schema, SURVEY.md §2.3).
+    """
+
+    def __init__(self, max_services: int = 1024, max_keys: int = 8192) -> None:
+        self.services = Interner(max_services)
+        self.span_names = Interner(max_keys)
+        self._keys: Dict[Tuple[int, int], int] = {}
+        self._key_list: List[Tuple[int, int]] = [(0, 0)]
+        self.max_keys = max_keys
+        self._overflow = 0
+        self._lock = threading.Lock()
+
+    def key_id(self, service_id: int, span_name_id: int) -> int:
+        pair = (service_id, span_name_id)
+        with self._lock:
+            got = self._keys.get(pair)
+            if got is not None:
+                return got
+            if len(self._key_list) >= self.max_keys:
+                self._overflow += 1
+                return 0
+            kid = len(self._key_list)
+            self._keys[pair] = kid
+            self._key_list.append(pair)
+            return kid
+
+    def key_pair(self, key_id: int) -> Tuple[int, int]:
+        return self._key_list[key_id] if 0 <= key_id < len(self._key_list) else (0, 0)
+
+    def key_ids_for_service(self, service_id: int) -> List[int]:
+        return [k for k, (s, _) in enumerate(self._key_list) if s == service_id and k]
+
+    @property
+    def num_keys(self) -> int:
+        return len(self._key_list)
+
+
+class SpanColumns(NamedTuple):
+    """One fixed-shape batch; every field is a numpy array of length n."""
+
+    trace_h: np.ndarray  # u32 avalanche hash of the full trace id
+    tl0: np.ndarray  # u32 trace id low-64 lanes (lo, hi of the low word)
+    tl1: np.ndarray
+    s0: np.ndarray  # u32 span id lanes
+    s1: np.ndarray
+    p0: np.ndarray  # u32 parent id lanes (0,0 = absent)
+    p1: np.ndarray
+    shared: np.ndarray  # bool
+    kind: np.ndarray  # i32 KIND_TO_ID
+    svc: np.ndarray  # i32 local service id
+    rsvc: np.ndarray  # i32 remote service id
+    key: np.ndarray  # i32 (service, spanName) sketch row
+    err: np.ndarray  # bool
+    dur: np.ndarray  # u32 duration µs (clamped), 0 if absent
+    has_dur: np.ndarray  # bool
+    ts_min: np.ndarray  # u32 epoch minutes (retention ring key)
+    valid: np.ndarray  # bool
+
+    @property
+    def size(self) -> int:
+        return int(self.valid.shape[0])
+
+    @property
+    def live(self) -> int:
+        return int(self.valid.sum())
+
+    def concat(self, other: "SpanColumns") -> "SpanColumns":
+        return SpanColumns(*(np.concatenate([a, b]) for a, b in zip(self, other)))
+
+
+def empty_columns(n: int) -> SpanColumns:
+    z32 = np.zeros(n, _U32)
+    return SpanColumns(
+        trace_h=z32.copy(), tl0=z32.copy(), tl1=z32.copy(),
+        s0=z32.copy(), s1=z32.copy(), p0=z32.copy(), p1=z32.copy(),
+        shared=np.zeros(n, bool), kind=np.zeros(n, np.int32),
+        svc=np.zeros(n, np.int32), rsvc=np.zeros(n, np.int32),
+        key=np.zeros(n, np.int32), err=np.zeros(n, bool),
+        dur=z32.copy(), has_dur=np.zeros(n, bool),
+        ts_min=z32.copy(), valid=np.zeros(n, bool),
+    )
+
+
+def _pad(n: int, multiple: int) -> int:
+    if n == 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def pack_spans(
+    spans: Sequence[Span], vocab: Vocab, pad_to_multiple: int = 1024
+) -> SpanColumns:
+    """Pack spans into a padded columnar batch, interning strings.
+
+    Padding to a small set of bucket sizes keeps jit cache hits high
+    (static shapes, SURVEY.md §7 P2 "pad/bucket to static shapes").
+    """
+    n = len(spans)
+    cap = _pad(n, pad_to_multiple)
+    cols = empty_columns(cap)
+
+    hi = np.zeros(n, np.uint64)
+    lo = np.zeros(n, np.uint64)
+    for i, span in enumerate(spans):
+        tid = normalize_trace_id(span.trace_id)
+        full = int(tid, 16)
+        lo[i] = full & 0xFFFFFFFFFFFFFFFF
+        hi[i] = full >> 64
+        sid = int(span.id, 16)
+        cols.s0[i] = sid & _MASK32
+        cols.s1[i] = (sid >> 32) & _MASK32
+        if span.parent_id:
+            pid = int(span.parent_id, 16)
+            cols.p0[i] = pid & _MASK32
+            cols.p1[i] = (pid >> 32) & _MASK32
+        cols.shared[i] = bool(span.shared)
+        cols.kind[i] = KIND_TO_ID[span.kind]
+        svc = vocab.services.intern(span.local_service_name)
+        cols.svc[i] = svc
+        cols.rsvc[i] = vocab.services.intern(span.remote_service_name)
+        name_id = vocab.span_names.intern(span.name)
+        cols.key[i] = vocab.key_id(svc, name_id)
+        cols.err[i] = span.is_error
+        if span.duration is not None:
+            cols.dur[i] = min(int(span.duration), _MASK32)
+            cols.has_dur[i] = True
+        if span.timestamp is not None:
+            cols.ts_min[i] = min(int(span.timestamp) // 60_000_000, _MASK32)
+        cols.valid[i] = True
+
+    cols.tl0[:n] = (lo & _MASK32).astype(_U32)
+    cols.tl1[:n] = (lo >> np.uint64(32)).astype(_U32)
+    hi32 = _hash2_np((hi & _MASK32).astype(_U32), (hi >> np.uint64(32)).astype(_U32))
+    cols.trace_h[:n] = _hash2_np(
+        _hash2_np(cols.tl0[:n], cols.tl1[:n]), hi32
+    )
+    return cols

@@ -1,0 +1,141 @@
+"""The jit'd ingest step and read kernels over :class:`AggState`.
+
+This is the device half of the reference's hot path (SURVEY.md §3.2):
+where ``Collector.acceptSpans`` fans bytes out to storage writers, the TPU
+tier applies one pure function ``state, batch -> state`` per shard —
+sketch scatter updates + a circular-buffer append — compiled once by XLA
+and re-used for every batch (static shapes via the packer's bucketed
+padding). Reads are pure functions over the same state.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zipkin_tpu.ops import hashing, histogram, hll, linker, tdigest
+from zipkin_tpu.tpu.columnar import SpanColumns
+from zipkin_tpu.tpu.state import (
+    CTR_BATCHES,
+    CTR_ERRORS,
+    CTR_SPANS,
+    CTR_WITH_DURATION,
+    AggConfig,
+    AggState,
+)
+
+
+def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggState:
+    """Fold one columnar batch into the aggregate state (pure, jit-safe).
+
+    Donate ``state`` at the jit boundary: updates are in-place in HBM.
+    """
+    valid = batch.valid
+    n = valid.shape[0]
+
+    # --- HLL: distinct traces per service + globally --------------------
+    h = hashing.fmix32(batch.trace_h)
+    svc_rows = jnp.clip(batch.svc, 0, config.max_services - 1)
+    new_hll = hll.update(state.hll, svc_rows, h, valid & (batch.svc > 0))
+    new_hll = hll.update(
+        new_hll, jnp.full((n,), config.global_hll_row, jnp.int32), h, valid
+    )
+
+    # --- latency sketches per (service, spanName) key -------------------
+    has_dur = valid & batch.has_dur
+    new_hist = histogram.update(state.hist, batch.key, batch.dur, has_dur)
+    new_digest = tdigest.update(
+        state.digest,
+        jnp.clip(batch.key, 0, config.max_keys - 1),
+        batch.dur.astype(jnp.float32),
+        has_dur.astype(jnp.float32),
+    )
+
+    # --- ring append (valid lanes first, advance by live count) ---------
+    order = jnp.argsort(~valid)  # stable: valid lanes keep order, pad sinks
+    live = jnp.sum(valid.astype(jnp.int32))
+    lane = jnp.arange(n, dtype=jnp.int32)
+    # pad lanes scatter out of range and are DROPPED — they must not
+    # clobber retained ring slots ahead of the cursor.
+    pos = jnp.where(
+        lane < live,
+        (state.ring_pos + lane) % config.ring_capacity,
+        config.ring_capacity,
+    )
+
+    def put(col, new):
+        return col.at[pos].set(new[order], mode="drop")
+
+    new_state = state._replace(
+        hll=new_hll,
+        hist=new_hist,
+        digest=new_digest,
+        r_trace_h=put(state.r_trace_h, batch.trace_h),
+        r_tl0=put(state.r_tl0, batch.tl0),
+        r_tl1=put(state.r_tl1, batch.tl1),
+        r_s0=put(state.r_s0, batch.s0),
+        r_s1=put(state.r_s1, batch.s1),
+        r_p0=put(state.r_p0, batch.p0),
+        r_p1=put(state.r_p1, batch.p1),
+        r_shared=put(state.r_shared, batch.shared),
+        r_kind=put(state.r_kind, batch.kind),
+        r_svc=put(state.r_svc, batch.svc),
+        r_rsvc=put(state.r_rsvc, batch.rsvc),
+        r_err=put(state.r_err, batch.err),
+        r_ts_min=put(state.r_ts_min, batch.ts_min),
+        r_valid=put(state.r_valid, valid),
+        ring_pos=(state.ring_pos + live) % config.ring_capacity,
+        counters=state.counters.at[CTR_SPANS].add(live.astype(jnp.uint32))
+        .at[CTR_WITH_DURATION].add(jnp.sum(has_dur).astype(jnp.uint32))
+        .at[CTR_ERRORS].add(jnp.sum(valid & batch.err).astype(jnp.uint32))
+        .at[CTR_BATCHES].add(1),
+    )
+    return new_state
+
+
+def ring_link_input(state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray) -> linker.LinkInput:
+    """View the retention ring as a link window restricted to [ts_lo, ts_hi]
+    epoch minutes (inclusive)."""
+    in_window = (state.r_ts_min >= ts_lo) & (state.r_ts_min <= ts_hi)
+    return linker.LinkInput(
+        trace_h=state.r_trace_h, tl0=state.r_tl0, tl1=state.r_tl1,
+        s0=state.r_s0, s1=state.r_s1, p0=state.r_p0, p1=state.r_p1,
+        shared=state.r_shared, kind=state.r_kind,
+        svc=state.r_svc, rsvc=state.r_rsvc, err=state.r_err,
+        valid=state.r_valid & in_window,
+    )
+
+
+def dependency_links(
+    config: AggConfig, state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(calls, errors) [S, S] u32 over the ring window — the on-device
+    replacement for the zipkin-dependencies batch job (SURVEY.md §3.5)."""
+    return linker.link_window(
+        ring_link_input(state, ts_lo, ts_hi), config.max_services
+    )
+
+
+def key_quantiles(state: AggState, qs: jnp.ndarray) -> jnp.ndarray:
+    """[keys, Q] latency quantiles from the histograms."""
+    return histogram.quantile(state.hist, qs)
+
+
+def key_quantiles_digest(state: AggState, qs: jnp.ndarray) -> jnp.ndarray:
+    """[keys, Q] latency quantiles from the t-digests (tighter tails)."""
+    return tdigest.quantile(state.digest, qs)
+
+
+def cardinalities(state: AggState) -> jnp.ndarray:
+    """[services+1] estimated distinct traces (last row = global)."""
+    return hll.estimate(state.hll)
+
+
+def jit_ingest(config: AggConfig):
+    """The compiled single-shard ingest step with state donation."""
+    return jax.jit(
+        functools.partial(ingest_step, config), donate_argnums=(0,)
+    )

@@ -1,0 +1,119 @@
+"""Checkpoint/resume of device sketch state.
+
+The reference has no in-process durability — it delegates to storage
+backends and replays from Kafka offsets (SURVEY.md §5 checkpoint row).
+The TPU tier's aggregates live in volatile HBM, so durability is
+explicit here: pull the sharded state to host, write one ``.npz`` plus
+the string vocabularies as JSON, restore on boot. Snapshots are atomic
+(write to temp, rename) and self-describing (config + shard count are
+validated on restore).
+
+Replay markers: the snapshot records ingest counters; transports that
+support offsets (replay files, Kafka) can resume from
+``counters["spans"]`` — the analog of Kafka consumer-offset resume.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import TYPE_CHECKING, Optional
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from zipkin_tpu.tpu.store import TpuStorage
+
+logger = logging.getLogger(__name__)
+
+STATE_FILE = "sketch_state.npz"
+META_FILE = "meta.json"
+
+
+def save(store: "TpuStorage", directory: str) -> str:
+    """Snapshot sketches + vocab into ``directory`` (atomic). Returns path."""
+    os.makedirs(directory, exist_ok=True)
+    state = store.agg.state
+    arrays = {f"f{i}": np.asarray(leaf) for i, leaf in enumerate(state)}
+
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+    with os.fdopen(fd, "wb") as f:  # file object: savez won't append ".npz"
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, os.path.join(directory, STATE_FILE))
+
+    meta = {
+        "version": 1,
+        "saved_at": time.time(),
+        "n_shards": store.agg.n_shards,
+        "config": {
+            "max_services": store.config.max_services,
+            "max_keys": store.config.max_keys,
+            "hll_precision": store.config.hll_precision,
+            "digest_centroids": store.config.digest_centroids,
+            "ring_capacity": store.config.ring_capacity,
+        },
+        "counters": store.ingest_counters(),
+        "services": store.vocab.services._names,
+        "span_names": store.vocab.span_names._names,
+        "keys": store.vocab._key_list,
+    }
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(directory, META_FILE))
+    return directory
+
+
+def maybe_restore(store: "TpuStorage", directory: str) -> bool:
+    """Restore state + vocab if a compatible snapshot exists."""
+    state_path = os.path.join(directory, STATE_FILE)
+    meta_path = os.path.join(directory, META_FILE)
+    if not (os.path.exists(state_path) and os.path.exists(meta_path)):
+        return False
+    with open(meta_path) as f:
+        meta = json.load(f)
+    want = {
+        "max_services": store.config.max_services,
+        "max_keys": store.config.max_keys,
+        "hll_precision": store.config.hll_precision,
+        "digest_centroids": store.config.digest_centroids,
+        "ring_capacity": store.config.ring_capacity,
+    }
+    if meta.get("config") != want or meta.get("n_shards") != store.agg.n_shards:
+        logger.warning(
+            "snapshot at %s is incompatible (config/shards changed); ignoring",
+            directory,
+        )
+        return False
+
+    import jax
+
+    loaded = np.load(state_path)
+    leaves = [loaded[f"f{i}"] for i in range(len(loaded.files))]
+    template = store.agg.state
+    if len(leaves) != len(template):
+        logger.warning("snapshot leaf count mismatch; ignoring")
+        return False
+    store.agg.state = jax.device_put(
+        type(template)(*leaves), store.agg._sharding
+    )
+
+    saved_counters = meta.get("counters", {})
+    for key in store.agg.host_counters:
+        if key in saved_counters:
+            store.agg.host_counters[key] = int(saved_counters[key])
+
+    # vocab restore (ids must keep their meaning across restarts)
+    store.vocab.services._names = list(meta["services"])
+    store.vocab.services._ids = {n: i for i, n in enumerate(meta["services"]) if i}
+    store.vocab.span_names._names = list(meta["span_names"])
+    store.vocab.span_names._ids = {
+        n: i for i, n in enumerate(meta["span_names"]) if i
+    }
+    store.vocab._key_list = [tuple(k) for k in meta["keys"]]
+    store.vocab._keys = {tuple(k): i for i, k in enumerate(meta["keys"]) if i}
+    logger.info("restored TPU sketch snapshot from %s", directory)
+    return True

@@ -1,0 +1,104 @@
+"""Device-resident aggregate state: the TPU replacement for storage rows.
+
+Where the reference materializes every span as rows + index tables
+(cassandra ``span`` / ``trace_by_service_span``, ES daily indices —
+SURVEY.md §2.3), the TPU tier keeps **fixed-shape aggregate state in HBM**
+(SURVEY.md §7 design stance):
+
+- ``hll``      — [services+1, m] u8: distinct-trace registers, row per
+                 service, last row global.
+- ``hist``     — [keys, BUCKETS] u32: per-(service, spanName) latency
+                 histograms (psum-mergeable).
+- ``digest``   — [keys, C, 2] f32: per-key t-digests for tight tails.
+- ring columns — a circular columnar span window (capacity R) feeding the
+                 windowed dependency-link job; the HBM analog of the
+                 reference's time-bucketed retention (daily ES indices).
+- ``counters`` — ingest telemetry (CollectorMetrics taxonomy, §2.2).
+
+The whole state is one NamedTuple pytree of arrays → trivially donatable,
+shard-able on a leading axis, and snapshot-able (tpu/snapshot.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from zipkin_tpu.ops import histogram
+
+# counter slots (keep CollectorMetrics names in docs/metrics export)
+CTR_SPANS, CTR_SPANS_DROPPED, CTR_WITH_DURATION, CTR_ERRORS, CTR_BATCHES = range(5)
+NUM_COUNTERS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class AggConfig:
+    """Static shapes of the device state; hashable so jit can close over it."""
+
+    max_services: int = 1024
+    max_keys: int = 8192
+    hll_precision: int = 11
+    digest_centroids: int = 64
+    ring_capacity: int = 1 << 17  # spans retained per shard for linking
+
+    @property
+    def hll_rows(self) -> int:
+        return self.max_services + 1
+
+    @property
+    def global_hll_row(self) -> int:
+        return self.max_services
+
+
+class AggState(NamedTuple):
+    hll: jnp.ndarray  # u8 [services+1, m]
+    hist: jnp.ndarray  # u32 [keys, BUCKETS]
+    digest: jnp.ndarray  # f32 [keys, C, 2]
+    # ring columns, all [R]
+    r_trace_h: jnp.ndarray  # u32
+    r_tl0: jnp.ndarray  # u32
+    r_tl1: jnp.ndarray  # u32
+    r_s0: jnp.ndarray  # u32
+    r_s1: jnp.ndarray  # u32
+    r_p0: jnp.ndarray  # u32
+    r_p1: jnp.ndarray  # u32
+    r_shared: jnp.ndarray  # bool
+    r_kind: jnp.ndarray  # i32
+    r_svc: jnp.ndarray  # i32
+    r_rsvc: jnp.ndarray  # i32
+    r_err: jnp.ndarray  # bool
+    r_ts_min: jnp.ndarray  # u32
+    r_valid: jnp.ndarray  # bool
+    ring_pos: jnp.ndarray  # i32 scalar
+    counters: jnp.ndarray  # u32 [NUM_COUNTERS]
+
+
+def init_state(config: AggConfig) -> AggState:
+    r = config.ring_capacity
+    z32 = jnp.zeros((r,), jnp.uint32)
+    return AggState(
+        hll=jnp.zeros((config.hll_rows, 1 << config.hll_precision), jnp.uint8),
+        hist=jnp.zeros((config.max_keys, histogram.BUCKETS), jnp.uint32),
+        digest=jnp.zeros((config.max_keys, config.digest_centroids, 2), jnp.float32),
+        r_trace_h=z32, r_tl0=z32, r_tl1=z32, r_s0=z32, r_s1=z32,
+        r_p0=z32, r_p1=z32,
+        r_shared=jnp.zeros((r,), bool),
+        r_kind=jnp.zeros((r,), jnp.int32),
+        r_svc=jnp.zeros((r,), jnp.int32),
+        r_rsvc=jnp.zeros((r,), jnp.int32),
+        r_err=jnp.zeros((r,), bool),
+        r_ts_min=z32,
+        r_valid=jnp.zeros((r,), bool),
+        ring_pos=jnp.zeros((), jnp.int32),
+        counters=jnp.zeros((NUM_COUNTERS,), jnp.uint32),
+    )
+
+
+def state_bytes(config: AggConfig) -> int:
+    """HBM footprint of one shard's state (for capacity planning)."""
+    import numpy as np
+
+    s = init_state(config)
+    return int(sum(np.prod(a.shape) * a.dtype.itemsize for a in s))

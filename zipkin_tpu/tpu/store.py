@@ -1,0 +1,251 @@
+"""TpuStorage: the StorageComponent backed by the device aggregation tier.
+
+This is the rebuild's ``zipkin-storage-tpu`` module (BASELINE north
+star): it implements the exact SPI of SURVEY.md §2.3 — so the collectors
+and server use it interchangeably with the in-memory oracle — while
+serving the aggregate read paths (dependencies, latency percentiles,
+cardinalities) straight from device sketches.
+
+Division of labor (hybrid by design, SURVEY.md §1 "TPU-rebuild mapping"):
+
+- **Device** (per shard, merged over ICI on read): latency histograms +
+  t-digests per (service, spanName), HLL trace cardinality per service,
+  dependency-link matrices over the retained span ring.
+- **Host archive**: a bounded `InMemoryStorage` keeps raw spans for exact
+  trace reads and search (`getTraces`) — the role the reference delegates
+  to row storage; beyond its eviction horizon, aggregates remain
+  queryable from the device (which is the point of the sketch tier).
+
+Idempotence: at-least-once transports can redeliver (SURVEY.md §3.3). The
+archive dedups by (traceId, spanId, ...); device sketches accept bounded
+double-count — the documented trade, testable against the oracle.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from zipkin_tpu.model.span import DependencyLink, Span
+from zipkin_tpu.ops import histogram as hist_ops
+from zipkin_tpu.ops import hll as hll_ops
+from zipkin_tpu.ops import tdigest as tdigest_ops
+from zipkin_tpu.storage.memory import InMemoryStorage
+from zipkin_tpu.storage.spi import (
+    AutocompleteTags,
+    QueryRequest,
+    ServiceAndSpanNames,
+    SpanConsumer,
+    SpanStore,
+    StorageComponent,
+)
+from zipkin_tpu.tpu.columnar import SpanColumns, Vocab, pack_spans
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.utils.call import Call
+from zipkin_tpu.utils.component import CheckResult, Component
+
+
+class TpuStorage(
+    StorageComponent, SpanConsumer, SpanStore, ServiceAndSpanNames, AutocompleteTags
+):
+    def __init__(
+        self,
+        *,
+        config: Optional[AggConfig] = None,
+        mesh=None,
+        strict_trace_id: bool = True,
+        search_enabled: bool = True,
+        autocomplete_keys: Sequence[str] = (),
+        archive_max_span_count: int = 500_000,
+        pad_to_multiple: int = 1024,
+    ) -> None:
+        from zipkin_tpu.parallel.sharded import ShardedAggregator
+
+        self.config = config or AggConfig()
+        self.strict_trace_id = strict_trace_id
+        self.search_enabled = search_enabled
+        self.autocomplete_keys = tuple(autocomplete_keys)
+        self.vocab = Vocab(
+            max_services=self.config.max_services, max_keys=self.config.max_keys
+        )
+        self.agg = ShardedAggregator(self.config, mesh=mesh)
+        self._archive = InMemoryStorage(
+            max_span_count=archive_max_span_count,
+            strict_trace_id=strict_trace_id,
+            search_enabled=search_enabled,
+            autocomplete_keys=autocomplete_keys,
+        )
+        self._pad = pad_to_multiple
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- SPI factories ---------------------------------------------------
+
+    def span_consumer(self) -> SpanConsumer:
+        return self
+
+    def span_store(self) -> SpanStore:
+        return self
+
+    def service_and_span_names(self) -> ServiceAndSpanNames:
+        return self
+
+    def autocomplete_tags(self) -> AutocompleteTags:
+        return self._archive
+
+    # -- write path ------------------------------------------------------
+
+    def accept(self, spans: Sequence[Span]) -> Call[None]:
+        def run() -> None:
+            if not spans:
+                return
+            self._archive.accept(spans).execute()
+            cols = pack_spans(spans, self.vocab, self._pad)
+            with self._lock:  # device state transition is single-writer
+                self.agg.ingest(cols)
+
+        return Call.of(run)
+
+    # -- raw trace reads: host archive -----------------------------------
+
+    def get_trace(self, trace_id: str) -> Call[List[Span]]:
+        return self._archive.get_trace(trace_id)
+
+    def get_traces(self, trace_ids: Sequence[str]) -> Call[List[List[Span]]]:
+        return self._archive.get_traces(trace_ids)
+
+    def get_traces_query(self, request: QueryRequest) -> Call[List[List[Span]]]:
+        return self._archive.get_traces_query(request)
+
+    def get_service_names(self) -> Call[List[str]]:
+        return self._archive.get_service_names()
+
+    def get_remote_service_names(self, service_name: str) -> Call[List[str]]:
+        return self._archive.get_remote_service_names(service_name)
+
+    def get_span_names(self, service_name: str) -> Call[List[str]]:
+        return self._archive.get_span_names(service_name)
+
+    def get_keys(self) -> Call[List[str]]:
+        return self._archive.get_keys()
+
+    def get_values(self, key: str) -> Call[List[str]]:
+        return self._archive.get_values(key)
+
+    # -- aggregate reads: device ----------------------------------------
+
+    def get_dependencies(self, end_ts: int, lookback: int) -> Call[List[DependencyLink]]:
+        def run() -> List[DependencyLink]:
+            lo_min = max((end_ts - lookback) // 60_000, 0)
+            hi_min = max(end_ts // 60_000, 0)
+            calls, errors = self.agg.dependency_matrices(int(lo_min), int(hi_min))
+            out: List[DependencyLink] = []
+            for p, c in zip(*np.nonzero(calls)):
+                parent = self.vocab.services.lookup(int(p))
+                child = self.vocab.services.lookup(int(c))
+                if not parent or not child:
+                    continue
+                out.append(
+                    DependencyLink(
+                        parent=parent,
+                        child=child,
+                        call_count=int(calls[p, c]),
+                        error_count=int(errors[p, c]),
+                    )
+                )
+            return out
+
+        return Call.of(run)
+
+    def latency_quantiles(
+        self,
+        qs: Sequence[float],
+        service_name: Optional[str] = None,
+        span_name: Optional[str] = None,
+        use_digest: bool = True,
+    ) -> List[dict]:
+        """Latency percentile rows per (service, spanName) — the read the
+        Lens duration-percentile context needs, served from sketches.
+
+        Returns dicts: {service, spanName, count, quantiles: {q: µs}}.
+        """
+        import jax.numpy as jnp
+
+        merged_hist, _, _ = self.agg.merged_sketches()
+        qarr = jnp.asarray(np.asarray(qs, np.float32))
+        if use_digest:
+            digest = self.agg.merged_digest()
+            source_q = np.asarray(tdigest_ops.quantile(digest, qarr))
+        else:
+            source_q = np.asarray(hist_ops.quantile(jnp.asarray(merged_hist), qarr))
+        counts = np.asarray(hist_ops.total_count(jnp.asarray(merged_hist)))
+
+        want_svc = (
+            self.vocab.services.get(service_name.lower()) if service_name else None
+        )
+        if service_name and want_svc is None:
+            return []
+        out = []
+        for kid in range(1, self.vocab.num_keys):
+            svc_id, name_id = self.vocab.key_pair(kid)
+            if want_svc is not None and svc_id != want_svc:
+                continue
+            name = self.vocab.span_names.lookup(name_id)
+            if span_name and name != span_name.lower():
+                continue
+            if counts[kid] == 0:
+                continue
+            out.append(
+                {
+                    "serviceName": self.vocab.services.lookup(svc_id),
+                    "spanName": name,
+                    "count": int(counts[kid]),
+                    "quantiles": {
+                        float(q): float(source_q[kid, i]) for i, q in enumerate(qs)
+                    },
+                }
+            )
+        return out
+
+    def trace_cardinalities(self) -> dict:
+        """Estimated distinct trace counts: {"_global": n, service: n, ...}."""
+        import jax.numpy as jnp
+
+        _, hll_regs, _ = self.agg.merged_sketches()
+        est = np.asarray(hll_ops.estimate(jnp.asarray(hll_regs)))
+        out = {"_global": float(est[self.config.global_hll_row])}
+        for name in self.vocab.services.names:
+            sid = self.vocab.services.get(name)
+            if sid:
+                out[name] = float(est[sid])
+        return out
+
+    def ingest_counters(self) -> dict:
+        # host counters: exact and wrap-free (device counters are u32)
+        return {
+            **self.agg.host_counters,
+            "serviceVocabOverflow": self.vocab.services.overflow,
+            "keyVocabOverflow": self.vocab._overflow,
+        }
+
+    # -- lifecycle -------------------------------------------------------
+
+    def check(self) -> CheckResult:
+        try:
+            self.agg.block_until_ready()
+            return CheckResult.OK
+        except Exception as e:  # pragma: no cover - device failure path
+            return CheckResult.failed(e)
+
+    def close(self) -> None:
+        self._closed = True
+        self._archive.close()
+
+    def clear(self) -> None:
+        """Test helper: drop archive + reset device state."""
+        from zipkin_tpu.parallel.sharded import ShardedAggregator
+
+        self._archive.clear()
+        self.agg = ShardedAggregator(self.config, mesh=self.agg.mesh)
