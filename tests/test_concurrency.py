@@ -1,0 +1,57 @@
+"""Concurrent ingest + aggregate reads must not race the donated device
+state (review finding: flush-on-read is a state WRITE). Hammers both
+paths from threads; any 'Array has been deleted' or lost batch fails."""
+
+import threading
+
+from tests.fixtures import lots_of_spans
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+CFG = AggConfig(
+    max_services=32, max_keys=128, hll_precision=8,
+    digest_centroids=16, digest_buffer=4096, ring_capacity=4096,
+)
+
+
+def test_concurrent_ingest_and_reads():
+    store = TpuStorage(config=CFG, pad_to_multiple=256)
+    spans = lots_of_spans(200, seed=17, services=4, span_names=4)
+    errors = []
+    n_writers, n_batches = 3, 8
+
+    def writer():
+        try:
+            for _ in range(n_batches):
+                store.accept(spans).execute()
+        except Exception as e:  # pragma: no cover - the failure under test
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(6):
+                store.latency_quantiles([0.5, 0.99], use_digest=True)
+                store.trace_cardinalities()
+                store.get_dependencies(2**40, 2**40 - 1).execute()
+                store.ingest_counters()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(n_writers)] + [
+        threading.Thread(target=reader) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert store.ingest_counters()["spans"] == n_writers * n_batches * len(spans)
+
+
+def test_oversized_batch_is_chunked():
+    store = TpuStorage(config=CFG, pad_to_multiple=256)
+    assert store.max_batch == CFG.digest_buffer
+    spans = lots_of_spans(CFG.digest_buffer + 500, seed=18, services=4, span_names=4)
+    store.accept(spans).execute()
+    assert store.ingest_counters()["spans"] == len(spans)
+    assert store.ingest_counters()["batches"] == 2

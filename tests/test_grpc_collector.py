@@ -1,0 +1,91 @@
+"""gRPC collector E2E: Report spans over a real channel, read them back
+(mirrors ITZipkinGrpcCollector, SURVEY.md §2.4)."""
+
+import asyncio
+
+import grpc
+import grpc.aio
+import pytest
+
+from tests.fixtures import TRACE
+from zipkin_tpu.collector.core import Collector
+from zipkin_tpu.model import proto3
+from zipkin_tpu.server.grpc import METHOD, GrpcCollectorServer
+from zipkin_tpu.storage.memory import InMemoryStorage
+
+
+def test_report_roundtrip():
+    async def scenario():
+        storage = InMemoryStorage()
+        server = GrpcCollectorServer(Collector(storage), host="127.0.0.1", port=0)
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{server.port}") as ch:
+                method = ch.unary_unary(METHOD)
+                body = proto3.encode_span_list(TRACE)
+                resp = await method(body)
+                assert resp == b""
+            trace = storage.get_trace(TRACE[0].trace_id).execute()
+            assert len(trace) == len(TRACE)
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_report_malformed_invalid_argument():
+    async def scenario():
+        storage = InMemoryStorage()
+        server = GrpcCollectorServer(Collector(storage), host="127.0.0.1", port=0)
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{server.port}") as ch:
+                method = ch.unary_unary(METHOD)
+                with pytest.raises(grpc.aio.AioRpcError) as err:
+                    await method(b"\xff\xff\xff")
+                assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_unknown_method_unimplemented():
+    async def scenario():
+        storage = InMemoryStorage()
+        server = GrpcCollectorServer(Collector(storage), host="127.0.0.1", port=0)
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{server.port}") as ch:
+                method = ch.unary_unary("/zipkin.proto3.SpanService/Nope")
+                with pytest.raises(grpc.aio.AioRpcError) as err:
+                    await method(b"")
+                assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_server_config_enables_grpc():
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+
+    async def scenario():
+        server = ZipkinServer(
+            ServerConfig(
+                port=0, grpc_collector_enabled=True, grpc_port=0,
+            ),
+            storage=InMemoryStorage(),
+        )
+        await server.start()
+        try:
+            gport = server._grpc.port
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{gport}") as ch:
+                await ch.unary_unary(METHOD)(proto3.encode_span_list(TRACE))
+            trace = server.storage.get_trace(TRACE[0].trace_id).execute()
+            assert len(trace) == len(TRACE)
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())

@@ -1,0 +1,51 @@
+"""The embedded mock server (ZipkinRule equivalent, SURVEY.md §2.6):
+record POSTs, inject failures, assert stored traces."""
+
+import urllib.error
+import urllib.request
+
+from tests.fixtures import TRACE
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.testkit import HttpFailure, ZipkinMock
+
+
+def _post(url: str, body: bytes) -> int:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status
+
+
+class TestZipkinMock:
+    def test_post_then_assert_traces(self):
+        with ZipkinMock() as zipkin:
+            status = _post(zipkin.http_url, json_v2.encode_span_list(TRACE))
+            assert status == 202
+            assert zipkin.http_request_count == 1
+            assert zipkin.trace_count == 1
+            assert len(zipkin.traces()[0]) == len(TRACE)
+            assert zipkin.collector_metrics().get("spans", "http") == len(TRACE)
+
+    def test_enqueued_failure_then_recovery(self):
+        with ZipkinMock() as zipkin:
+            zipkin.enqueue_failure(HttpFailure.send_error_response(503, "go away"))
+            try:
+                _post(zipkin.http_url, json_v2.encode_span_list(TRACE))
+                raised = None
+            except urllib.error.HTTPError as e:
+                raised = e.code
+            assert raised == 503
+            assert zipkin.trace_count == 0  # failure consumed, nothing stored
+            # next request succeeds (FIFO consumption)
+            assert _post(zipkin.http_url, json_v2.encode_span_list(TRACE)) == 202
+            assert zipkin.trace_count == 1
+            assert zipkin.http_request_count == 2
+
+    def test_store_spans_seeds_query_api(self):
+        with ZipkinMock() as zipkin:
+            zipkin.store_spans(TRACE)
+            url = f"{zipkin.base_url}/api/v2/trace/{TRACE[0].trace_id}"
+            with urllib.request.urlopen(url) as resp:
+                assert resp.status == 200
+                assert b"frontend" in resp.read()

@@ -244,11 +244,13 @@ class TransportCollector(CollectorComponent):
         self._poll_timeout = poll_timeout
         self._threads: List[threading.Thread] = []
         self._running = threading.Event()
-        self._lock = threading.Lock()  # single-poller sources
-        # messages polled but not yet stored (storage failure): retried
-        # before the next poll so a transient rejection loses nothing
-        # in-process. Crash durability remains the committed offset.
-        self._retry: List[Message] = []
+        # guards poll/commit only (single-poller sources); decode+store run
+        # OUTSIDE it so workers > 1 actually parallelize (reference: N
+        # KafkaCollectorWorker streams). Each worker keeps its own retry
+        # list of polled-but-unstored messages (transient storage failure),
+        # so a rejection loses nothing in-process; crash durability remains
+        # the committed offset.
+        self._lock = threading.Lock()
 
     def start(self) -> "TransportCollector":
         self._running.set()
@@ -260,50 +262,54 @@ class TransportCollector(CollectorComponent):
             self._threads.append(t)
         return self
 
-    def _process(self, messages: List[Message]) -> bool:
-        """Store a batch; on storage failure stash the unstored tail for
-        retry (no in-process loss). Returns True if the batch finished."""
+    def _process(self, messages: List[Message]) -> List[Message]:
+        """Store a batch; returns the unstored tail on storage failure
+        (empty when the batch finished). Commits under the poll lock."""
         high = -1
+        leftover: List[Message] = []
         for i, m in enumerate(messages):
             try:
                 self.collector.accept_spans_bytes(m.payload)
             except ValueError:
                 pass  # poison pill: counted dropped by the collector, skip
             except Exception:
-                self._retry = messages[i:]  # retried before the next poll
-                if high >= 0:
-                    self.source.commit(high)
-                return False
+                leftover = messages[i:]  # retried before the next poll
+                break
             high = max(high, m.offset)
         if high >= 0:
-            self.source.commit(high)  # after accept: at-least-once
-        return True
-
-    def _poll_or_retry(self, timeout: float) -> List[Message]:
-        if self._retry:
-            messages, self._retry = self._retry, []
-            return messages
-        return self.source.poll(self._poll_batch, timeout)
+            with self._lock:
+                self.source.commit(high)  # after accept: at-least-once
+        return leftover
 
     def _run(self) -> None:
+        retry: List[Message] = []
         while self._running.is_set():
-            with self._lock:
-                messages = self._poll_or_retry(self._poll_timeout)
-                if messages and not self._process(messages):
+            if retry:
+                messages, retry = retry, []
+            else:
+                with self._lock:
+                    messages = self.source.poll(self._poll_batch, self._poll_timeout)
+            if messages:
+                retry = self._process(messages)
+                if retry:
                     time.sleep(self._poll_timeout)  # back off before retry
 
     def drain(self, deadline: float = 5.0) -> None:
         """Test helper: poll inline until the source stops yielding."""
         end = time.monotonic() + deadline
         idle = 0
+        retry: List[Message] = []
         while time.monotonic() < end and idle < 3:
-            with self._lock:
-                messages = self._poll_or_retry(0.05)
-                if messages:
-                    idle = 0
-                    self._process(messages)
-                else:
-                    idle += 1
+            if retry:
+                messages, retry = retry, []
+            else:
+                with self._lock:
+                    messages = self.source.poll(self._poll_batch, 0.05)
+            if messages:
+                idle = 0
+                retry = self._process(messages)
+            else:
+                idle += 1
 
     def check(self) -> CheckResult:
         return self.source.check()

@@ -10,6 +10,7 @@ the 8-virtual-device CPU backend used in CI.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -106,7 +107,19 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     merge = jax.jit(
         shard_map(spmd_merge, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
     )
-    return init, step, links, merge, sharding
+
+    def spmd_flush(state: AggState) -> AggState:
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        out = ing.flush_digest(config, s)
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    flush = jax.jit(
+        shard_map(
+            spmd_flush, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS)
+        ),
+        donate_argnums=(0,),
+    )
+    return init, step, links, merge, flush, sharding
 
 
 class ShardedAggregator:
@@ -120,7 +133,7 @@ class ShardedAggregator:
         self.config = config
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape))
-        init, self._step, self._links, self._merge, self._sharding = (
+        init, self._step, self._links, self._merge, self._flush, self._sharding = (
             _compiled_programs(config, mesh)
         )
         self.state: AggState = init()
@@ -133,6 +146,11 @@ class ShardedAggregator:
             "spansWithError": 0,
             "batches": 0,
         }
+        # Guards every touch of self.state. Ingest DONATES the state
+        # buffers, so a reader racing a step would touch deleted arrays
+        # (or, for the flush-on-read path, silently drop a batch by
+        # overwriting the step's result). Reentrant: read paths nest.
+        self.lock = threading.RLock()
 
     # -- write path ------------------------------------------------------
 
@@ -143,34 +161,49 @@ class ShardedAggregator:
         else:
             routed = route_columns(cols, self.n_shards)
         device_batch = jax.device_put(routed, self._sharding)
-        self.state = self._step(self.state, device_batch)
-        c = self.host_counters
-        c["spans"] += int(cols.valid.sum())
-        c["spansWithDuration"] += int((cols.valid & cols.has_dur).sum())
-        c["spansWithError"] += int((cols.valid & cols.err).sum())
-        c["batches"] += 1
+        with self.lock:
+            self.state = self._step(self.state, device_batch)
+            c = self.host_counters
+            c["spans"] += int(cols.valid.sum())
+            c["spansWithDuration"] += int((cols.valid & cols.has_dur).sum())
+            c["spansWithError"] += int((cols.valid & cols.err).sum())
+            c["batches"] += 1
 
     # -- read path (merged across shards over ICI) -----------------------
 
     def merged_sketches(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(hist [K,B], hll [S+1,m], counters) merged over all shards."""
-        hist, hll_regs, counters = self._merge(self.state)
-        return np.asarray(hist), np.asarray(hll_regs), np.asarray(counters)
+        with self.lock:
+            hist, hll_regs, counters = self._merge(self.state)
+            return np.asarray(hist), np.asarray(hll_regs), np.asarray(counters)
 
     def dependency_matrices(
         self, ts_lo_min: int, ts_hi_min: int
     ) -> Tuple[np.ndarray, np.ndarray]:
-        calls, errors = self._links(
-            self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
-        )
-        return np.asarray(calls), np.asarray(errors)
+        with self.lock:
+            calls, errors = self._links(
+                self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
+            )
+            return np.asarray(calls), np.asarray(errors)
 
     def merged_digest(self) -> jnp.ndarray:
-        """[K, C, 2] t-digest merged across shards (host-side compaction)."""
+        """[K, C, 2] t-digest merged across shards (host-side compaction).
+
+        Flushes each shard's pending buffer first so reads are complete —
+        a state WRITE, hence the lock.
+        """
         from zipkin_tpu.ops import tdigest
 
-        stacked = np.asarray(self.state.digest)  # [D, K, C, 2]
+        with self.lock:
+            self.state = self._flush(self.state)
+            stacked = np.asarray(self.state.digest)  # [D, K, C, 2]
         return tdigest.merge_many(stacked)
 
+    def state_arrays(self) -> list:
+        """Consistent host copy of every state leaf (snapshot path)."""
+        with self.lock:
+            return [np.asarray(leaf) for leaf in self.state]
+
     def block_until_ready(self) -> None:
-        jax.tree_util.tree_map(lambda a: a.block_until_ready(), self.state)
+        with self.lock:
+            jax.tree_util.tree_map(lambda a: a.block_until_ready(), self.state)

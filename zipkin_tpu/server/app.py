@@ -99,6 +99,7 @@ class ZipkinServer:
         )
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
+        self._grpc = None
 
     # -- app ---------------------------------------------------------------
 
@@ -136,10 +137,26 @@ class ZipkinServer:
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.host, self.config.port)
         await site.start()
+        if self.config.grpc_collector_enabled:
+            from zipkin_tpu.server.grpc import GrpcCollectorServer
+
+            self._grpc = GrpcCollectorServer(
+                Collector(
+                    self.storage,
+                    sampler=self.collector.sampler,
+                    metrics=self.metrics.for_transport("grpc"),
+                ),
+                host=self.config.host,
+                port=self.config.grpc_port,
+            )
+            await self._grpc.start()
         logger.info("zipkin-tpu listening on :%d", self.config.port)
         return self
 
     async def stop(self) -> None:
+        if self._grpc is not None:
+            await self._grpc.stop()
+            self._grpc = None
         if self._runner is not None:
             await self._runner.cleanup()
         self.storage.close()

@@ -1,0 +1,82 @@
+"""gRPC span collector: ``zipkin.proto3.SpanService/Report``.
+
+Reference semantics: ``ZipkinGrpcCollector.java`` (SURVEY.md §2.4),
+enabled by ``COLLECTOR_GRPC_ENABLED``. Like the reference — which ships
+hand-rolled proto field writers instead of protoc codegen — this uses the
+framework's own proto3 codec (zipkin_tpu/model/proto3.py) and registers a
+generic method handler, so there is no generated stub to drift from the
+wire format.
+
+The request body IS a ``ListOfSpans`` (the same bytes the HTTP collector
+accepts as application/x-protobuf); the response is an empty
+``ReportResponse``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import grpc
+import grpc.aio
+
+from zipkin_tpu.collector.core import Collector
+from zipkin_tpu.model.codec import Encoding
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "zipkin.proto3.SpanService"
+METHOD = f"/{SERVICE}/Report"
+
+
+class _SpanServiceHandler(grpc.GenericRpcHandler):
+    def __init__(self, collector: Collector) -> None:
+        self._collector = collector
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != METHOD:
+            return None
+
+        async def report(request: bytes, context) -> bytes:
+            try:
+                # off the event loop: decode + device ingest block, and the
+                # loop is shared with the HTTP site (same fix as app.py)
+                await asyncio.to_thread(
+                    self._collector.accept_spans_bytes, request, Encoding.PROTO3
+                )
+            except ValueError as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except Exception as e:  # storage rejection -> retryable
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            return b""  # empty ReportResponse
+
+        return grpc.unary_unary_rpc_method_handler(
+            report,
+            request_deserializer=None,  # raw bytes: our codec decodes
+            response_serializer=None,
+        )
+
+
+class GrpcCollectorServer:
+    """Lifecycle wrapper: bind, serve, drain."""
+
+    def __init__(self, collector: Collector, host: str = "0.0.0.0", port: int = 9412):
+        self._collector = collector
+        self._address = f"{host}:{port}"
+        self._server: Optional[grpc.aio.Server] = None
+        self.port = port
+
+    async def start(self) -> "GrpcCollectorServer":
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers((_SpanServiceHandler(self._collector),))
+        self.port = server.add_insecure_port(self._address)
+        await server.start()
+        self._server = server
+        logger.info("grpc collector listening on %s", self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None

@@ -1,0 +1,19 @@
+"""Test kit: an embedded mock Zipkin for instrumentation tests.
+
+Reference semantics: ``zipkin-junit``'s ``ZipkinRule`` /
+``zipkin-junit5``'s ``ZipkinExtension`` (SURVEY.md §2.6) — a real HTTP
+endpoint that records what clients POST, can inject failures
+(``HttpFailure.sendErrorResponse`` / ``disconnectDuringBody``), and
+exposes stored traces + collector metrics for assertions.
+
+Usage (sync facade over the aiohttp server, runs its own loop thread):
+
+    with ZipkinMock() as zipkin:
+        my_tracer.configure(endpoint=zipkin.http_url)
+        ... exercise instrumented code ...
+        assert zipkin.trace_count == 1
+"""
+
+from zipkin_tpu.testkit.mock import HttpFailure, ZipkinMock
+
+__all__ = ["HttpFailure", "ZipkinMock"]

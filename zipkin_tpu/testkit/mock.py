@@ -1,0 +1,145 @@
+"""The embedded mock server behind :class:`ZipkinMock`.
+
+Implementation notes: a private event loop on a daemon thread runs the
+same ``ZipkinServer`` app as production over in-memory storage, so mock
+behavior can't drift from the real collector; failure injection wraps the
+ingest route the way ``ZipkinRule`` enqueues ``HttpFailure``s ahead of
+OkHttp's MockWebServer responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import threading
+from collections import deque
+from typing import Deque, List, Optional, Sequence
+
+from aiohttp import web
+
+from zipkin_tpu.model.span import Span
+from zipkin_tpu.server.app import ZipkinServer
+from zipkin_tpu.server.config import ServerConfig
+from zipkin_tpu.storage.memory import InMemoryStorage
+
+
+@dataclasses.dataclass(frozen=True)
+class HttpFailure:
+    """One enqueued ingest failure (consumed in FIFO order)."""
+
+    status: int = 500
+    body: str = "injected failure"
+    disconnect: bool = False
+
+    @staticmethod
+    def send_error_response(status: int, body: str = "") -> "HttpFailure":
+        return HttpFailure(status=status, body=body)
+
+    @staticmethod
+    def disconnect_during_body() -> "HttpFailure":
+        return HttpFailure(disconnect=True)
+
+
+class ZipkinMock:
+    """Embedded mock zipkin; start()/close() or use as a context manager."""
+
+    def __init__(self, port: int = 0) -> None:
+        self.storage = InMemoryStorage()
+        self._config = ServerConfig(host="127.0.0.1", port=port)
+        self._failures: Deque[HttpFailure] = deque()
+        self._request_count = 0
+        self._server: Optional[ZipkinServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self.port: Optional[int] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ZipkinMock":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("mock zipkin failed to start")
+        return self
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._start_async())
+        self._started.set()
+        self._loop.run_forever()
+
+    async def _start_async(self) -> None:
+        server = ZipkinServer(self._config, storage=self.storage)
+        app = server.make_app()
+        app.middlewares.append(self._failure_middleware)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", self._config.port)
+        await site.start()
+        self.port = runner.addresses[0][1]
+        self._runner = runner
+        self._server = server
+
+    @web.middleware
+    async def _failure_middleware(self, request: web.Request, handler):
+        if request.method == "POST" and request.path.endswith("/spans"):
+            self._request_count += 1
+            if self._failures:
+                failure = self._failures.popleft()
+                if failure.disconnect:
+                    await request.read()
+                    request.transport.close()
+                    raise web.HTTPInternalServerError()  # connection is gone
+                return web.Response(status=failure.status, text=failure.body)
+        return await handler(request)
+
+    def close(self) -> None:
+        if self._loop is not None:
+            async def _stop():
+                await self._runner.cleanup()
+
+            fut = asyncio.run_coroutine_threadsafe(_stop(), self._loop)
+            fut.result(timeout=5)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+            self._loop = None
+
+    def __enter__(self) -> "ZipkinMock":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- assertions ------------------------------------------------------
+
+    @property
+    def http_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/api/v2/spans"
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def http_request_count(self) -> int:
+        return self._request_count
+
+    @property
+    def trace_count(self) -> int:
+        return len(self.storage.get_all_traces())
+
+    def traces(self) -> List[List[Span]]:
+        return self.storage.get_all_traces()
+
+    def store_spans(self, spans: Sequence[Span]) -> None:
+        """Seed spans directly (ZipkinRule#storeSpans)."""
+        self.storage.accept(list(spans)).execute()
+
+    def enqueue_failure(self, failure: HttpFailure) -> None:
+        self._failures.append(failure)
+
+    def collector_metrics(self):
+        assert self._server is not None
+        return self._server.metrics

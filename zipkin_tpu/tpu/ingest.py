@@ -47,11 +47,10 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     # --- latency sketches per (service, spanName) key -------------------
     has_dur = valid & batch.has_dur
     new_hist = histogram.update(state.hist, batch.key, batch.dur, has_dur)
-    new_digest = tdigest.update(
-        state.digest,
-        jnp.clip(batch.key, 0, config.max_keys - 1),
-        batch.dur.astype(jnp.float32),
-        has_dur.astype(jnp.float32),
+    # t-digest: append to the pending buffer; compact only when it would
+    # overflow (amortizes the K*C-point sort across ~P/n batches).
+    new_digest, pend_key, pend_val, pend_pos = _digest_buffered_update(
+        config, state, batch.key, batch.dur.astype(jnp.float32), has_dur
     )
 
     # --- ring append (valid lanes first, advance by live count) ---------
@@ -73,6 +72,9 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
         hll=new_hll,
         hist=new_hist,
         digest=new_digest,
+        pend_key=pend_key,
+        pend_val=pend_val,
+        pend_pos=pend_pos,
         r_trace_h=put(state.r_trace_h, batch.trace_h),
         r_tl0=put(state.r_tl0, batch.tl0),
         r_tl1=put(state.r_tl1, batch.tl1),
@@ -94,6 +96,57 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
         .at[CTR_BATCHES].add(1),
     )
     return new_state
+
+
+def _flush_pending_digest(
+    config: AggConfig, digest: jnp.ndarray, pend_key: jnp.ndarray, pend_val: jnp.ndarray
+):
+    """Compact the whole pending buffer into the digests (empty lanes have
+    key -1 -> weight 0)."""
+    w = (pend_key >= 0).astype(jnp.float32)
+    keys = jnp.clip(pend_key, 0, config.max_keys - 1)
+    return tdigest.update(digest, keys, pend_val, w)
+
+
+def _digest_buffered_update(
+    config: AggConfig, state: AggState, key, val, has_dur
+):
+    n = key.shape[0]
+    p = config.digest_buffer
+    batch_key = jnp.where(has_dur, jnp.clip(key, 0, config.max_keys - 1), -1)
+
+    def with_flush():
+        d = _flush_pending_digest(config, state.digest, state.pend_key, state.pend_val)
+        # derive the resets from state so they stay shard-varying under
+        # shard_map (fresh constants would not match the other cond branch)
+        return (
+            d,
+            jnp.full_like(state.pend_key, -1),
+            jnp.zeros_like(state.pend_val),
+            jnp.zeros_like(state.pend_pos),
+        )
+
+    def without_flush():
+        return state.digest, state.pend_key, state.pend_val, state.pend_pos
+
+    digest, pk, pv, pos = jax.lax.cond(
+        state.pend_pos + n > p, with_flush, without_flush
+    )
+    pk = jax.lax.dynamic_update_slice(pk, batch_key, (pos,))
+    pv = jax.lax.dynamic_update_slice(pv, val, (pos,))
+    return digest, pk, pv, pos + n
+
+
+def flush_digest(config: AggConfig, state: AggState) -> AggState:
+    """Reader-side flush: fold any pending values so digest reads are
+    complete. Pure; call via jit before quantile queries."""
+    d = _flush_pending_digest(config, state.digest, state.pend_key, state.pend_val)
+    return state._replace(
+        digest=d,
+        pend_key=jnp.full_like(state.pend_key, -1),
+        pend_val=jnp.zeros_like(state.pend_val),
+        pend_pos=jnp.zeros_like(state.pend_pos),
+    )
 
 
 def ring_link_input(state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray) -> linker.LinkInput:

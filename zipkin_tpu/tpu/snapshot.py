@@ -36,8 +36,9 @@ META_FILE = "meta.json"
 def save(store: "TpuStorage", directory: str) -> str:
     """Snapshot sketches + vocab into ``directory`` (atomic). Returns path."""
     os.makedirs(directory, exist_ok=True)
-    state = store.agg.state
-    arrays = {f"f{i}": np.asarray(leaf) for i, leaf in enumerate(state)}
+    # consistent copy under the aggregator lock: concurrent ingest donates
+    # the buffers this would otherwise be reading
+    arrays = {f"f{i}": leaf for i, leaf in enumerate(store.agg.state_arrays())}
 
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     with os.fdopen(fd, "wb") as f:  # file object: savez won't append ".npz"
@@ -53,6 +54,7 @@ def save(store: "TpuStorage", directory: str) -> str:
             "max_keys": store.config.max_keys,
             "hll_precision": store.config.hll_precision,
             "digest_centroids": store.config.digest_centroids,
+            "digest_buffer": store.config.digest_buffer,
             "ring_capacity": store.config.ring_capacity,
         },
         "counters": store.ingest_counters(),
@@ -80,6 +82,7 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
         "max_keys": store.config.max_keys,
         "hll_precision": store.config.hll_precision,
         "digest_centroids": store.config.digest_centroids,
+        "digest_buffer": store.config.digest_buffer,
         "ring_capacity": store.config.ring_capacity,
     }
     if meta.get("config") != want or meta.get("n_shards") != store.agg.n_shards:
@@ -97,9 +100,10 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
     if len(leaves) != len(template):
         logger.warning("snapshot leaf count mismatch; ignoring")
         return False
-    store.agg.state = jax.device_put(
-        type(template)(*leaves), store.agg._sharding
-    )
+    with store.agg.lock:
+        store.agg.state = jax.device_put(
+            type(template)(*leaves), store.agg._sharding
+        )
 
     saved_counters = meta.get("counters", {})
     for key in store.agg.host_counters:

@@ -41,6 +41,11 @@ class AggConfig:
     max_keys: int = 8192
     hll_precision: int = 11
     digest_centroids: int = 64
+    # t-digest pending buffer: batches append here (cheap) and the big
+    # sort-based compaction runs only when it fills — the classic digest
+    # buffering trade, amortizing the K*C-point sort across many batches.
+    # Must be >= the largest packed batch size.
+    digest_buffer: int = 1 << 16
     ring_capacity: int = 1 << 17  # spans retained per shard for linking
 
     @property
@@ -56,6 +61,9 @@ class AggState(NamedTuple):
     hll: jnp.ndarray  # u8 [services+1, m]
     hist: jnp.ndarray  # u32 [keys, BUCKETS]
     digest: jnp.ndarray  # f32 [keys, C, 2]
+    pend_key: jnp.ndarray  # i32 [P] — -1 = empty lane
+    pend_val: jnp.ndarray  # f32 [P]
+    pend_pos: jnp.ndarray  # i32 scalar
     # ring columns, all [R]
     r_trace_h: jnp.ndarray  # u32
     r_tl0: jnp.ndarray  # u32
@@ -82,6 +90,9 @@ def init_state(config: AggConfig) -> AggState:
         hll=jnp.zeros((config.hll_rows, 1 << config.hll_precision), jnp.uint8),
         hist=jnp.zeros((config.max_keys, histogram.BUCKETS), jnp.uint32),
         digest=jnp.zeros((config.max_keys, config.digest_centroids, 2), jnp.float32),
+        pend_key=jnp.full((config.digest_buffer,), -1, jnp.int32),
+        pend_val=jnp.zeros((config.digest_buffer,), jnp.float32),
+        pend_pos=jnp.zeros((), jnp.int32),
         r_trace_h=z32, r_tl0=z32, r_tl1=z32, r_s0=z32, r_s1=z32,
         r_p0=z32, r_p1=z32,
         r_shared=jnp.zeros((r,), bool),

@@ -23,7 +23,6 @@ double-count — the documented trade, testable against the oracle.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -78,7 +77,9 @@ class TpuStorage(
             autocomplete_keys=autocomplete_keys,
         )
         self._pad = pad_to_multiple
-        self._lock = threading.Lock()
+        # largest single device batch: bounded by the digest pending buffer
+        # (dynamic_update_slice of a batch bigger than it cannot trace)
+        self.max_batch = min(self.config.digest_buffer, 8192)
         self._closed = False
 
     # -- SPI factories ---------------------------------------------------
@@ -102,8 +103,10 @@ class TpuStorage(
             if not spans:
                 return
             self._archive.accept(spans).execute()
-            cols = pack_spans(spans, self.vocab, self._pad)
-            with self._lock:  # device state transition is single-writer
+            # chunk: a giant POST must not exceed the device batch bound
+            # (state transitions serialize on the aggregator's own lock)
+            for lo in range(0, len(spans), self.max_batch):
+                cols = pack_spans(spans[lo : lo + self.max_batch], self.vocab, self._pad)
                 self.agg.ingest(cols)
 
         return Call.of(run)
