@@ -1,0 +1,3 @@
+"""Micro-benchmark harnesses (the JMH `benchmarks/` analog, SURVEY.md
+§2.6): runnable mains printing JSON lines; results are informational, not
+CI-asserted — same policy as the reference."""

@@ -1,0 +1,53 @@
+"""Codec encode/decode throughput across formats.
+
+Mirrors ``zipkin2/codec/CodecBenchmarks.java``: the same canonical
+CLIENT_SPAN / 3-span TRACE corpus, each format's encode and decode
+measured separately. Run: ``python -m benchmarks.codec_bench``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from tests.fixtures import TRACE, lots_of_spans
+from zipkin_tpu.model import codec
+from zipkin_tpu.model.codec import Encoding
+
+
+def _bench(fn, *, seconds: float = 1.0) -> float:
+    """Calls/second of fn."""
+    fn()  # warm
+    count, start = 0, time.perf_counter()
+    while True:
+        fn()
+        count += 1
+        elapsed = time.perf_counter() - start
+        if elapsed >= seconds:
+            return count / elapsed
+
+
+def main() -> None:
+    corpus = {"trace3": TRACE, "spans1k": lots_of_spans(1000, seed=1)}
+    out = []
+    for name, spans in corpus.items():
+        for encoding in (Encoding.JSON_V2, Encoding.JSON_V1, Encoding.PROTO3, Encoding.THRIFT):
+            body = codec.encode_spans(spans, encoding)
+            spans_per_msg = len(spans)
+            enc_rate = _bench(lambda: codec.encode_spans(spans, encoding))
+            dec_rate = _bench(lambda: codec.decode_spans(body, encoding))
+            out.append(
+                {
+                    "corpus": name,
+                    "format": encoding.name,
+                    "encode_spans_per_sec": round(enc_rate * spans_per_msg),
+                    "decode_spans_per_sec": round(dec_rate * spans_per_msg),
+                    "bytes": len(body),
+                }
+            )
+    for row in out:
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Device op micro-benchmarks: per-op ms/batch on the current backend.
+
+The device analog of ``WriteBufferBenchmarks`` — measures the hot ops
+(hll update, histogram update, digest compaction, link job) in isolation
+so regressions are attributable. Run: ``python -m benchmarks.ops_bench``
+(real TPU by default; CPU with JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timeit(fn, *args, iters: int = 20) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / iters * 1e3
+
+
+def main() -> None:
+    from zipkin_tpu.ops import hashing, histogram, hll, tdigest
+
+    n = 8192
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.integers(0, 1024, n).astype(np.int32))
+    hashes = hashing.fmix32(jnp.arange(n, dtype=jnp.uint32))
+    durs = jnp.asarray(rng.integers(1, 10**7, n).astype(np.uint32))
+    valid = jnp.ones(n, bool)
+
+    regs = hll.new_registers(1025, precision=11)
+    hll_ms = _timeit(jax.jit(hll.update), regs, rows, hashes, valid)
+
+    hist = histogram.new_histograms(8192)
+    keys = jnp.asarray(rng.integers(0, 8192, n).astype(np.int32))
+    hist_ms = _timeit(jax.jit(histogram.update), hist, keys, durs, valid)
+
+    digests = tdigest.new_digests(8192, 64)
+    dig_ms = _timeit(
+        jax.jit(tdigest.update), digests, keys, durs.astype(jnp.float32),
+        valid.astype(jnp.float32),
+    )
+
+    for name, ms in (
+        ("hll_update", hll_ms),
+        ("histogram_update", hist_ms),
+        ("tdigest_full_compaction", dig_ms),
+    ):
+        print(
+            json.dumps(
+                {
+                    "op": name,
+                    "batch": n,
+                    "ms_per_batch": round(ms, 3),
+                    "spans_per_sec": round(n / (ms / 1e3)),
+                    "backend": jax.default_backend(),
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

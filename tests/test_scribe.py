@@ -1,0 +1,94 @@
+"""Scribe collector: thrift-framed Log RPC with base64 thrift spans
+(mirrors the scribe module ITs, SURVEY.md §2.2)."""
+
+import asyncio
+import base64
+import struct
+
+from tests.fixtures import TRACE
+from zipkin_tpu.collector.core import Collector
+from zipkin_tpu.collector.scribe import OK, ScribeCollector, _parse_log_call
+from zipkin_tpu.model import thrift
+from zipkin_tpu.storage.memory import InMemoryStorage
+
+_T_STOP, _T_STRING, _T_STRUCT, _T_LIST, _T_I32 = 0, 11, 12, 15, 8
+_VERSION_1 = 0x80010000 - (1 << 32)  # as signed i32
+
+
+def _log_call(entries, seqid=7) -> bytes:
+    """Encode scribe.Log(List<LogEntry>) as a versioned framed call."""
+    name = b"Log"
+    body = struct.pack(">i", _VERSION_1 | 1)  # CALL
+    body += struct.pack(">i", len(name)) + name
+    body += struct.pack(">i", seqid)
+    body += bytes([_T_LIST]) + struct.pack(">h", 1)
+    body += bytes([_T_STRUCT]) + struct.pack(">i", len(entries))
+    for category, message in entries:
+        body += bytes([_T_STRING]) + struct.pack(">h", 1)
+        body += struct.pack(">i", len(category)) + category
+        body += bytes([_T_STRING]) + struct.pack(">h", 2)
+        body += struct.pack(">i", len(message)) + message
+        body += bytes([_T_STOP])
+    body += bytes([_T_STOP])
+    return struct.pack(">I", len(body)) + body
+
+
+def _entries_for(spans):
+    return [
+        (b"zipkin", base64.b64encode(thrift.encode_span(s))) for s in spans
+    ]
+
+
+def test_parse_log_call():
+    frame = _log_call(_entries_for(TRACE))[4:]
+    seqid, entries = _parse_log_call(frame)
+    assert seqid == 7
+    assert len(entries) == len(TRACE)
+    assert entries[0][0] == "zipkin"
+
+
+def test_scribe_roundtrip():
+    async def scenario():
+        storage = InMemoryStorage()
+        scribe = ScribeCollector(Collector(storage), host="127.0.0.1", port=0)
+        await scribe.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", scribe.port)
+            writer.write(_log_call(_entries_for(TRACE)))
+            await writer.drain()
+            header = await reader.readexactly(4)
+            (length,) = struct.unpack(">I", header)
+            reply = await reader.readexactly(length)
+            # versioned REPLY for "Log" with ResultCode OK
+            assert b"Log" in reply
+            assert reply.endswith(bytes([_T_I32]) + struct.pack(">hi", 0, OK) + b"\x00")
+            writer.close()
+        finally:
+            await scribe.stop()
+        trace = storage.get_trace(TRACE[0].trace_id).execute()
+        assert len(trace) == len(TRACE)
+        # client/server pair semantics survive the v1 conversion
+        kinds = {(s.id, s.kind.value if s.kind else None) for s in trace}
+        assert ("0000000000000002", "CLIENT") in kinds
+        assert ("0000000000000002", "SERVER") in kinds
+
+    asyncio.run(scenario())
+
+
+def test_non_zipkin_category_ignored():
+    async def scenario():
+        storage = InMemoryStorage()
+        scribe = ScribeCollector(Collector(storage), host="127.0.0.1", port=0)
+        await scribe.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", scribe.port)
+            writer.write(_log_call([(b"other", base64.b64encode(b"junk"))]))
+            await writer.drain()
+            header = await reader.readexactly(4)
+            await reader.readexactly(struct.unpack(">I", header)[0])
+            writer.close()
+        finally:
+            await scribe.stop()
+        assert storage.span_count == 0
+
+    asyncio.run(scenario())

@@ -1,0 +1,85 @@
+"""Self-tracing: the server records its own request handling
+(SELF_TRACING_ENABLED, SURVEY.md §5)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.fixtures import TRACE
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.server.app import ZipkinServer
+from zipkin_tpu.server.config import ServerConfig
+from zipkin_tpu.storage.memory import InMemoryStorage
+
+
+def _run(scenario, **cfg):
+    async def wrapper():
+        server = ZipkinServer(
+            ServerConfig(self_tracing_enabled=True, **cfg),
+            storage=InMemoryStorage(),
+        )
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            await scenario(client, server)
+        finally:
+            await client.close()
+
+    asyncio.run(wrapper())
+
+
+async def _self_spans(server, tries=50):
+    for _ in range(tries):
+        traces = [
+            t
+            for t in server.storage.get_all_traces()
+            if any(s.local_service_name == "zipkin-server" for s in t)
+        ]
+        if traces:
+            return [s for t in traces for s in t]
+        await asyncio.sleep(0.05)
+    return []
+
+
+def test_query_requests_traced():
+    async def scenario(client, server):
+        resp = await client.get("/api/v2/services")
+        assert resp.status == 200
+        spans = await _self_spans(server)
+        assert spans, "expected a self-trace span"
+        span = spans[0]
+        assert span.kind is not None and span.kind.value == "SERVER"
+        assert span.tags["http.path"] == "/api/v2/services"
+        assert span.tags["http.status_code"] == "200"
+
+    _run(scenario)
+
+
+def test_b3_headers_joined():
+    async def scenario(client, server):
+        resp = await client.get(
+            "/api/v2/services",
+            headers={"X-B3-TraceId": "00000000000000ff", "X-B3-SpanId": "00000000000000aa"},
+        )
+        assert resp.status == 200
+        spans = await _self_spans(server)
+        joined = [s for s in spans if s.trace_id.endswith("ff")]
+        assert joined and joined[0].parent_id == "00000000000000aa"
+
+    _run(scenario)
+
+
+def test_ingest_traced_alongside_real_spans():
+    async def scenario(client, server):
+        resp = await client.post(
+            "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+            headers={"Content-Type": "application/json"},
+        )
+        assert resp.status == 202
+        spans = await _self_spans(server)
+        assert any(s.tags.get("http.path") == "/api/v2/spans" for s in spans)
+        # the real trace also landed
+        trace = server.storage.get_trace(TRACE[0].trace_id).execute()
+        assert len(trace) == len(TRACE)
+
+    _run(scenario)

@@ -108,3 +108,31 @@ class TestKafkaGated:
 
         with pytest.raises(RuntimeError, match="kafka-python is not installed"):
             KafkaSource("broker:9092")
+
+
+class TestCommitWatermark:
+    """A fast worker must not commit past a slower worker's unstored
+    offsets (cumulative-commit sources would mark them consumed)."""
+
+    def test_watermark_holds_below_outstanding(self):
+        storage = InMemoryStorage()
+        source = QueueSource()
+        tc = TransportCollector(source, _collector(storage), transport="queue")
+        # worker A polled 0-4 but hasn't stored them; worker B polled 5-9
+        tc._outstanding.update(range(10))
+        for off in range(5, 10):
+            tc._mark_stored(off)
+        assert source.committed == -1  # 0-4 still outstanding
+        for off in range(5):
+            tc._mark_stored(off)
+        assert source.committed == 9  # everything stored -> full commit
+
+    def test_poison_pill_advances_watermark(self):
+        storage = InMemoryStorage()
+        source = QueueSource()
+        tc = TransportCollector(source, _collector(storage), transport="queue")
+        source.send(b"\xff\xff garbage")
+        source.send(json_v2.encode_span_list(TRACE))
+        tc.drain(2.0)
+        assert source.committed == 1  # pill consumed, not stuck
+        assert storage.span_count == len(TRACE)

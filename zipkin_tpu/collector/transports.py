@@ -40,13 +40,15 @@ from zipkin_tpu.utils.component import CheckResult
 
 
 class Message:
-    """One opaque payload plus its resume offset."""
+    """One opaque payload plus its resume offset (and optional transport
+    metadata, e.g. a STOMP ack id)."""
 
-    __slots__ = ("payload", "offset")
+    __slots__ = ("payload", "offset", "meta")
 
-    def __init__(self, payload: bytes, offset: int) -> None:
+    def __init__(self, payload: bytes, offset: int, meta=None) -> None:
         self.payload = payload
         self.offset = offset
+        self.meta = meta
 
 
 class MessageSource:
@@ -215,6 +217,104 @@ class KafkaSource(MessageSource):
         self._consumer.close()
 
 
+class RabbitMQSource(MessageSource):
+    """RabbitMQ basic-consume on queue ``zipkin`` via pika, if installed.
+
+    Mirrors ``RabbitMQCollector.java``: basic_get polling with explicit
+    acks after storage accept (at-least-once).
+    """
+
+    def __init__(self, uri: str, queue: str = "zipkin") -> None:
+        try:
+            import pika  # type: ignore
+        except ImportError as e:  # pragma: no cover - not in this image
+            raise RuntimeError(
+                "pika is not installed; use ReplayFileSource or QueueSource, "
+                "or install pika"
+            ) from e
+        self._connection = pika.BlockingConnection(  # pragma: no cover
+            pika.URLParameters(uri)
+        )
+        self._channel = self._connection.channel()  # pragma: no cover
+        self._queue = queue
+
+    def poll(self, max_messages, timeout):  # pragma: no cover
+        out = []
+        for _ in range(max_messages):
+            method, _props, body = self._channel.basic_get(self._queue)
+            if method is None:
+                break
+            out.append(Message(body, method.delivery_tag))
+        return out
+
+    def commit(self, offset) -> None:  # pragma: no cover
+        # delivery tags are cumulative: one multiple-ack covers <= offset
+        self._channel.basic_ack(offset, multiple=True)
+
+    def close(self) -> None:  # pragma: no cover
+        self._connection.close()
+
+
+class ActiveMQSource(MessageSource):
+    """ActiveMQ queue consume via stomp.py, if installed.
+
+    Mirrors ``ActiveMQCollector.java`` (JMS consume -> accept); STOMP is
+    the broker protocol available to Python.
+    """
+
+    def __init__(self, host: str, port: int = 61613, queue: str = "zipkin") -> None:
+        try:
+            import stomp  # type: ignore
+        except ImportError as e:  # pragma: no cover - not in this image
+            raise RuntimeError(
+                "stomp.py is not installed; use ReplayFileSource or "
+                "QueueSource, or install stomp.py"
+            ) from e
+        import queue as pyqueue  # pragma: no cover
+
+        self._buffer = pyqueue.Queue()  # pragma: no cover
+        self._conn = stomp.Connection([(host, port)])  # pragma: no cover
+
+        outer = self
+
+        class _Listener(stomp.ConnectionListener):  # pragma: no cover
+            def on_message(self, frame):
+                outer._buffer.put((frame.body.encode(), frame.headers))
+
+        self._conn.set_listener("zipkin", _Listener())  # pragma: no cover
+        self._conn.connect(wait=True)  # pragma: no cover
+        self._conn.subscribe(f"/queue/{queue}", id=1, ack="client-individual")  # pragma: no cover
+        self._seq = 0
+        self._unacked: dict = {}  # offset -> stomp ack id
+
+    def poll(self, max_messages, timeout):  # pragma: no cover
+        import queue as pyqueue
+
+        out = []
+        deadline = time.monotonic() + timeout
+        while len(out) < max_messages:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                body, headers = self._buffer.get(timeout=remaining)
+            except pyqueue.Empty:
+                break
+            ack_id = headers.get("ack") or headers.get("message-id")
+            self._unacked[self._seq] = ack_id
+            out.append(Message(body, self._seq, meta=ack_id))
+            self._seq += 1
+        return out
+
+    def commit(self, offset) -> None:  # pragma: no cover
+        # client-individual ack mode: ack every delivered frame <= offset
+        for off in sorted(k for k in self._unacked if k <= offset):
+            self._conn.ack(self._unacked.pop(off))
+
+    def close(self) -> None:  # pragma: no cover
+        self._conn.disconnect()
+
+
 # -- the collector component ---------------------------------------------
 
 
@@ -244,13 +344,19 @@ class TransportCollector(CollectorComponent):
         self._poll_timeout = poll_timeout
         self._threads: List[threading.Thread] = []
         self._running = threading.Event()
-        # guards poll/commit only (single-poller sources); decode+store run
-        # OUTSIDE it so workers > 1 actually parallelize (reference: N
-        # KafkaCollectorWorker streams). Each worker keeps its own retry
-        # list of polled-but-unstored messages (transient storage failure),
-        # so a rejection loses nothing in-process; crash durability remains
-        # the committed offset.
+        # guards poll/commit + watermark bookkeeping (single-poller
+        # sources); decode+store run OUTSIDE it so workers > 1 actually
+        # parallelize (reference: N KafkaCollectorWorker streams). Each
+        # worker keeps its own retry list of polled-but-unstored messages
+        # (transient storage failure), so a rejection loses nothing
+        # in-process; crash durability remains the committed offset.
         self._lock = threading.Lock()
+        # Sources commit CUMULATIVELY (replay marker, kafka group offset,
+        # rabbit multiple-ack), so with several workers a fast worker must
+        # not commit past a slower worker's still-unstored offsets:
+        # track outstanding offsets and only commit below their minimum.
+        self._outstanding: set = set()
+        self._stored_high = -1
 
     def start(self) -> "TransportCollector":
         self._running.set()
@@ -262,24 +368,37 @@ class TransportCollector(CollectorComponent):
             self._threads.append(t)
         return self
 
+    def _poll(self, timeout: float) -> List[Message]:
+        with self._lock:
+            messages = self.source.poll(self._poll_batch, timeout)
+            self._outstanding.update(m.offset for m in messages)
+            return messages
+
+    def _mark_stored(self, offset: int) -> None:
+        """Record one stored message and commit the safe watermark: the
+        highest stored offset with nothing unstored at or below it."""
+        with self._lock:
+            self._outstanding.discard(offset)
+            self._stored_high = max(self._stored_high, offset)
+            floor = min(self._outstanding) - 1 if self._outstanding else self._stored_high
+            watermark = min(self._stored_high, floor)
+            if watermark >= 0:
+                self.source.commit(watermark)  # after accept: at-least-once
+
     def _process(self, messages: List[Message]) -> List[Message]:
         """Store a batch; returns the unstored tail on storage failure
-        (empty when the batch finished). Commits under the poll lock."""
-        high = -1
-        leftover: List[Message] = []
+        (empty when the batch finished)."""
         for i, m in enumerate(messages):
             try:
                 self.collector.accept_spans_bytes(m.payload)
             except ValueError:
-                pass  # poison pill: counted dropped by the collector, skip
+                # poison pill: counted dropped by the collector; it is
+                # terminally consumed, so it still advances the watermark
+                pass
             except Exception:
-                leftover = messages[i:]  # retried before the next poll
-                break
-            high = max(high, m.offset)
-        if high >= 0:
-            with self._lock:
-                self.source.commit(high)  # after accept: at-least-once
-        return leftover
+                return messages[i:]  # retried before the next poll
+            self._mark_stored(m.offset)
+        return []
 
     def _run(self) -> None:
         retry: List[Message] = []
@@ -287,8 +406,7 @@ class TransportCollector(CollectorComponent):
             if retry:
                 messages, retry = retry, []
             else:
-                with self._lock:
-                    messages = self.source.poll(self._poll_batch, self._poll_timeout)
+                messages = self._poll(self._poll_timeout)
             if messages:
                 retry = self._process(messages)
                 if retry:
@@ -303,8 +421,7 @@ class TransportCollector(CollectorComponent):
             if retry:
                 messages, retry = retry, []
             else:
-                with self._lock:
-                    messages = self.source.poll(self._poll_batch, 0.05)
+                messages = self._poll(0.05)
             if messages:
                 idle = 0
                 retry = self._process(messages)

@@ -44,6 +44,7 @@ _ENCODERS: dict = {
     Encoding.JSON_V2: json_v2.encode_span_list,
     Encoding.JSON_V1: json_v1.encode_v1_span_list,
     Encoding.PROTO3: proto3.encode_span_list,
+    Encoding.THRIFT: thrift.encode_span_list,
 }
 
 

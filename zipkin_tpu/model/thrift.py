@@ -259,3 +259,156 @@ def decode_span_list(data: bytes) -> List[Span]:
     count = r.i32()
     v1_spans = [_read_v1_span(r) for _ in range(count)]
     return convert_v1_spans(v1_spans)
+
+
+# -- writer (SpanBytesEncoder.THRIFT parity) -------------------------------
+
+
+class _Writer:
+    """Minimal TBinaryProtocol writer."""
+
+    def __init__(self) -> None:
+        self.parts: List[bytes] = []
+
+    def u8(self, v: int) -> None:
+        self.parts.append(struct.pack(">B", v))
+
+    def i16(self, v: int) -> None:
+        self.parts.append(struct.pack(">h", v))
+
+    def i32(self, v: int) -> None:
+        self.parts.append(struct.pack(">i", v))
+
+    def i64(self, v: int) -> None:
+        self.parts.append(struct.pack(">q", v & 0xFFFFFFFFFFFFFFFF if v >= 0 else v))
+
+    def binary(self, v: bytes) -> None:
+        self.i32(len(v))
+        self.parts.append(v)
+
+    def field(self, ftype: int, fid: int) -> None:
+        self.u8(ftype)
+        self.i16(fid)
+
+    def stop(self) -> None:
+        self.u8(_T_STOP)
+
+    def bytes(self) -> bytes:
+        return b"".join(self.parts)
+
+
+def _u64(hex_id: Optional[str]) -> int:
+    return int(hex_id, 16) if hex_id else 0
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _write_endpoint(w: _Writer, ep: Optional[Endpoint]) -> None:
+    if ep is None:
+        ep = Endpoint()
+    if ep.ipv4:
+        w.field(_T_I32, 1)
+        w.i32(int(ipaddress.IPv4Address(ep.ipv4)) - (1 << 32) if int(ipaddress.IPv4Address(ep.ipv4)) >= (1 << 31) else int(ipaddress.IPv4Address(ep.ipv4)))
+    if ep.port:
+        w.field(_T_I16, 2)
+        w.i16(ep.port - (1 << 16) if ep.port >= (1 << 15) else ep.port)
+    w.field(_T_STRING, 3)
+    w.binary((ep.service_name or "").encode())
+    if ep.ipv6:
+        w.field(_T_STRING, 4)
+        w.binary(ipaddress.IPv6Address(ep.ipv6).packed)
+    w.stop()
+
+
+_BEGIN_END = {
+    "CLIENT": ("cs", "cr"),
+    "SERVER": ("sr", "ss"),
+    "PRODUCER": ("ms", None),
+    "CONSUMER": ("mr", None),
+}
+_ADDR = {"CLIENT": "sa", "SERVER": "ca", "PRODUCER": "ma", "CONSUMER": "ma"}
+
+
+def encode_span(span: Span) -> bytes:
+    """One v2 span as a thrift v1 Span struct (the scribe message body).
+
+    Same v2->v1 mapping as the JSON v1 encoder: kind becomes cs/cr/sr/ss
+    core annotations, tags become string binary annotations,
+    remoteEndpoint the matching address annotation.
+    """
+    w = _Writer()
+    w.field(_T_I64, 1)
+    w.i64(_signed64(_u64(span.trace_id[-16:])))
+    w.field(_T_STRING, 3)
+    w.binary((span.name or "").encode())
+    w.field(_T_I64, 4)
+    w.i64(_signed64(_u64(span.id)))
+    if span.parent_id:
+        w.field(_T_I64, 5)
+        w.i64(_signed64(_u64(span.parent_id)))
+
+    anns = []
+    kind = span.kind.value if span.kind else None
+    begin_end = _BEGIN_END.get(kind) if kind else None
+    if begin_end and span.timestamp:
+        begin, end = begin_end
+        anns.append((span.timestamp, begin))
+        if end and span.duration:
+            anns.append((span.timestamp + span.duration, end))
+    for a in span.annotations:
+        anns.append((a.timestamp, a.value))
+    w.field(_T_LIST, 6)
+    w.u8(_T_STRUCT)
+    w.i32(len(anns))
+    for ts, value in anns:
+        w.field(_T_I64, 1)
+        w.i64(ts)
+        w.field(_T_STRING, 2)
+        w.binary(value.encode())
+        w.field(_T_STRUCT, 3)
+        _write_endpoint(w, span.local_endpoint)
+        w.stop()
+
+    bins = [(k, v.encode(), 6, span.local_endpoint) for k, v in span.tags.items()]
+    if span.remote_endpoint is not None and kind:
+        bins.append((_ADDR[kind], b"\x01", 0, span.remote_endpoint))
+    w.field(_T_LIST, 8)
+    w.u8(_T_STRUCT)
+    w.i32(len(bins))
+    for key, value, btype, ep in bins:
+        w.field(_T_STRING, 1)
+        w.binary(key.encode())
+        w.field(_T_STRING, 2)
+        w.binary(value)
+        w.field(_T_I32, 3)
+        w.i32(btype)
+        w.field(_T_STRUCT, 4)
+        _write_endpoint(w, ep)
+        w.stop()
+
+    if span.debug:
+        w.field(_T_BOOL, 9)
+        w.u8(1)
+    if span.timestamp and not span.shared:
+        w.field(_T_I64, 10)
+        w.i64(span.timestamp)
+    if span.duration and not span.shared:
+        w.field(_T_I64, 11)
+        w.i64(span.duration)
+    if len(span.trace_id) == 32:
+        w.field(_T_I64, 12)
+        w.i64(_signed64(_u64(span.trace_id[:16])))
+    w.stop()
+    return w.bytes()
+
+
+def encode_span_list(spans: List[Span]) -> bytes:
+    """thrift list<Span> (first byte 0x0c), the ingest wire shape."""
+    w = _Writer()
+    w.u8(_T_STRUCT)
+    w.i32(len(spans))
+    out = [w.bytes()]
+    out.extend(encode_span(s) for s in spans)
+    return b"".join(out)

@@ -100,11 +100,24 @@ class ZipkinServer:
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
         self._grpc = None
+        self._scribe = None
 
     # -- app ---------------------------------------------------------------
 
     def make_app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
+        if self.config.self_tracing_enabled:
+            from zipkin_tpu.server.self_tracing import self_tracing_middleware
+
+            app.middlewares.append(
+                self_tracing_middleware(
+                    Collector(
+                        self.storage,
+                        metrics=self.metrics.for_transport("self"),
+                    ),
+                    sample_rate=self.config.self_tracing_sample_rate,
+                )
+            )
         r = app.router
         if self.config.http_collector_enabled:
             r.add_post("/api/v2/spans", self.post_spans_v2)
@@ -129,7 +142,14 @@ class ZipkinServer:
         r.add_get("/metrics", self.get_metrics)
         r.add_get("/prometheus", self.get_prometheus)
         r.add_get("/config.json", self.get_ui_config)
+        r.add_get("/zipkin/", self.get_ui)
+        r.add_get("/zipkin", self.get_ui)
         return app
+
+    async def get_ui(self, request: web.Request) -> web.Response:
+        from zipkin_tpu.server.ui import PAGE
+
+        return web.Response(text=PAGE, content_type="text/html")
 
     async def start(self) -> "ZipkinServer":
         app = self.make_app()
@@ -150,10 +170,27 @@ class ZipkinServer:
                 port=self.config.grpc_port,
             )
             await self._grpc.start()
+        if self.config.scribe_enabled:
+            from zipkin_tpu.collector.scribe import ScribeCollector
+
+            self._scribe = ScribeCollector(
+                Collector(
+                    self.storage,
+                    sampler=self.collector.sampler,
+                    metrics=self.metrics.for_transport("scribe"),
+                ),
+                host=self.config.host,
+                port=self.config.scribe_port,
+            )
+            await self._scribe.start()
+            self.components["scribe"] = self._scribe
         logger.info("zipkin-tpu listening on :%d", self.config.port)
         return self
 
     async def stop(self) -> None:
+        if self._scribe is not None:
+            await self._scribe.stop()
+            self._scribe = None
         if self._grpc is not None:
             await self._grpc.stop()
             self._grpc = None
@@ -414,6 +451,11 @@ class ZipkinServer:
             lines.append(
                 f'zipkin_collector_{name}_total{{transport="{transport}"}} {value}'
             )
+        if hasattr(self.storage, "ingest_counters"):
+            # device-tier gauges (sketch occupancy / ingest truth counters)
+            counters = await asyncio.to_thread(self.storage.ingest_counters)
+            for name, value in sorted(counters.items()):
+                lines.append(f"zipkin_tpu_{_snake(name)} {value}")
         return web.Response(text="\n".join(lines) + "\n")
 
     async def get_ui_config(self, request: web.Request) -> web.Response:
@@ -427,6 +469,17 @@ class ZipkinServer:
                 "dependency": {"enabled": True},
             }
         )
+
+
+def _snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
 
 
 def parse_annotation_query(raw: Optional[str]) -> Dict[str, str]:

@@ -55,9 +55,12 @@ class ServerConfig:
     http_collector_enabled: bool = True
     grpc_collector_enabled: bool = False
     grpc_port: int = 9412
+    scribe_enabled: bool = False
+    scribe_port: int = 9410
     throttle_enabled: bool = False
     throttle_max_concurrency: int = 8
     self_tracing_enabled: bool = False
+    self_tracing_sample_rate: float = 1.0
     # TPU aggregation tier
     tpu_devices: Optional[int] = None  # None = all visible
     tpu_batch_size: int = 8192
@@ -79,9 +82,12 @@ class ServerConfig:
             http_collector_enabled=_env_bool("COLLECTOR_HTTP_ENABLED", True),
             grpc_collector_enabled=_env_bool("COLLECTOR_GRPC_ENABLED", False),
             grpc_port=_env_int("COLLECTOR_GRPC_PORT", 9412),
+            scribe_enabled=_env_bool("COLLECTOR_SCRIBE_ENABLED", False),
+            scribe_port=_env_int("COLLECTOR_SCRIBE_PORT", 9410),
             throttle_enabled=_env_bool("STORAGE_THROTTLE_ENABLED", False),
             throttle_max_concurrency=_env_int("STORAGE_THROTTLE_MAX_CONCURRENCY", 8),
             self_tracing_enabled=_env_bool("SELF_TRACING_ENABLED", False),
+            self_tracing_sample_rate=_env_float("SELF_TRACING_SAMPLE_RATE", 1.0),
             tpu_devices=_env_int("TPU_DEVICES", 0) or None,
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,

@@ -1,0 +1,81 @@
+"""Self-tracing: the server traces its own request handling into itself.
+
+Reference semantics: ``SELF_TRACING_ENABLED`` wires Brave into the server
+and stores its own spans (SURVEY.md §5 tracing row). Here: an aiohttp
+middleware records one SERVER span per handled request — method/path/
+status tags, error tag on 5xx — sampled by ``SELF_TRACING_SAMPLE_RATE``
+and fed through the normal collector pipeline (so self-spans are subject
+to the same sampling/metrics as any other span).
+
+B3 propagation: incoming ``X-B3-TraceId``/``X-B3-SpanId`` headers join
+the caller's trace the way Brave would; otherwise a fresh trace id is
+minted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from zipkin_tpu.collector.core import Collector, CollectorSampler
+from zipkin_tpu.model.span import Endpoint, Kind, Span
+
+SERVICE_NAME = "zipkin-server"
+
+
+def _new_id() -> str:
+    return f"{random.getrandbits(64) or 1:016x}"
+
+
+def self_tracing_middleware(collector: Collector, sample_rate: float = 1.0):
+    sampler = CollectorSampler(sample_rate)
+    endpoint = Endpoint.create(SERVICE_NAME)
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        trace_id = request.headers.get("X-B3-TraceId")
+        parent_id: Optional[str] = request.headers.get("X-B3-SpanId")
+        if not trace_id:
+            trace_id, parent_id = _new_id(), None
+        start = time.time_ns() // 1000
+        status = 500
+        try:
+            response = await handler(request)
+            status = response.status
+            return response
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            duration = max(time.time_ns() // 1000 - start, 1)
+            try:
+                span = Span.create(
+                    trace_id=trace_id,
+                    id=_new_id(),
+                    parent_id=parent_id,
+                    kind=Kind.SERVER,
+                    name=f"{request.method.lower()} {request.path}",
+                    timestamp=start,
+                    duration=duration,
+                    local_endpoint=endpoint,
+                    tags={
+                        "http.method": request.method,
+                        "http.path": request.path,
+                        "http.status_code": str(status),
+                        **({"error": str(status)} if status >= 500 else {}),
+                    },
+                )
+                if sampler.test(span):
+                    # fire-and-forget off the event loop: storing a span
+                    # may hit the device and must not stall serving
+                    asyncio.get_running_loop().run_in_executor(
+                        None, collector.accept, [span]
+                    )
+            except Exception:  # self-tracing must never break serving
+                pass
+
+    return middleware

@@ -1,0 +1,107 @@
+"""Minimal built-in UI served at /zipkin/.
+
+The reference serves the Lens React bundle from the server jar
+(SURVEY.md §2.5); the rebuild keeps **API-shape compatibility** so Lens
+itself can be pointed at this server, and ships this small dependency-free
+page for the same three views (search, trace detail, dependencies) plus
+the TPU percentile extension — consuming only the public JSON API.
+"""
+
+PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>zipkin-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#fafafa;color:#222}
+ header{background:#1a237e;color:#fff;padding:10px 16px;display:flex;gap:16px;align-items:center}
+ header h1{font-size:16px;margin:0}
+ main{padding:16px;max-width:1100px;margin:auto}
+ section{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px;margin-bottom:16px}
+ h2{font-size:14px;margin:0 0 8px}
+ table{border-collapse:collapse;width:100%;font-size:13px}
+ td,th{border-bottom:1px solid #eee;padding:4px 6px;text-align:left}
+ .bar{background:#3f51b5;height:10px;border-radius:2px}
+ .err{color:#b71c1c}
+ select,input,button{font-size:13px;padding:3px 6px}
+ .muted{color:#777}
+</style></head><body>
+<header><h1>zipkin-tpu</h1><span id="info" class="muted"></span></header>
+<main>
+<section><h2>Find traces</h2>
+ <select id="svc"><option value="">all services</option></select>
+ <input id="limit" type="number" value="10" style="width:4em">
+ <button onclick="findTraces()">search</button>
+ <div id="traces"></div>
+ <div id="detail"></div>
+</section>
+<section><h2>Dependencies</h2><button onclick="deps()">refresh</button>
+ <table id="deptab"><tr><th>parent</th><th>child</th><th>calls</th><th>errors</th></tr></table>
+</section>
+<section><h2>Latency percentiles (TPU sketches)</h2><button onclick="pcts()">refresh</button>
+ <table id="pcttab"><tr><th>service</th><th>span</th><th>count</th><th>p50 µs</th><th>p99 µs</th></tr></table>
+</section>
+</main>
+<script>
+const $=q=>document.querySelector(q);
+const get=async p=>{const r=await fetch(p);if(!r.ok)throw new Error(p+': '+r.status);return r.json()};
+// span fields are attacker-controlled (anyone can POST to the collector):
+// everything interpolated into markup goes through esc(), and trace ids
+// are validated as hex before being used in an onclick.
+const esc=s=>String(s??'').replace(/[&<>"'`]/g,c=>'&#'+c.charCodeAt(0)+';');
+const hexOnly=s=>/^[0-9a-f]{1,32}$/.test(s)?s:'';
+async function boot(){
+  try{const i=await get('/info');$('#info').textContent='v'+i.zipkin.version;}catch(e){}
+  try{const s=await get('/api/v2/services');
+    for(const n of s){const o=document.createElement('option');o.value=o.textContent=n;$('#svc').append(o)}}catch(e){}
+}
+async function findTraces(){
+  const svc=$('#svc').value, lim=$('#limit').value||10;
+  const q=new URLSearchParams({endTs:Date.now(),lookback:7*864e5,limit:lim});
+  if(svc)q.set('serviceName',svc);
+  const traces=await get('/api/v2/traces?'+q);
+  const el=$('#traces');el.innerHTML='';
+  const t=document.createElement('table');
+  t.innerHTML='<tr><th>trace</th><th>spans</th><th>duration µs</th><th></th></tr>';
+  for(const tr of traces){
+    const root=tr.reduce((a,b)=>(a.timestamp||1e18)<(b.timestamp||1e18)?a:b);
+    const id=hexOnly(root.traceId);
+    const row=document.createElement('tr');
+    row.innerHTML=`<td>${esc(id)}</td><td>${tr.length}</td><td>${esc(root.duration||'')}</td>
+      <td><button onclick="detail('${id}')">view</button></td>`;
+    t.append(row);
+  }
+  el.append(t);
+}
+async function detail(id){
+  const spans=await get('/api/v2/trace/'+id);
+  const t0=Math.min(...spans.map(s=>s.timestamp||1e18));
+  const total=Math.max(...spans.map(s=>(s.timestamp||t0)+(s.duration||0)))-t0||1;
+  const el=$('#detail');
+  let h=`<h2>trace ${esc(hexOnly(id))}</h2><table><tr><th>service</th><th>span</th><th>timeline</th><th>µs</th></tr>`;
+  for(const s of spans.sort((a,b)=>(a.timestamp||0)-(b.timestamp||0))){
+    const off=100*((s.timestamp||t0)-t0)/total, w=Math.max(100*(s.duration||0)/total,0.5);
+    const err=s.tags&&s.tags.error!==undefined;
+    h+=`<tr class="${err?'err':''}"><td>${esc((s.localEndpoint||{}).serviceName||'')}</td>
+      <td>${esc(s.name||'')} ${esc(s.kind||'')}</td>
+      <td style="width:50%"><div class="bar" style="margin-left:${off}%;width:${w}%"></div></td>
+      <td>${esc(s.duration||'')}</td></tr>`;
+  }
+  el.innerHTML=h+'</table>';
+}
+async function deps(){
+  const links=await get('/api/v2/dependencies?endTs='+Date.now()+'&lookback='+7*864e5);
+  const t=$('#deptab');t.innerHTML='<tr><th>parent</th><th>child</th><th>calls</th><th>errors</th></tr>';
+  for(const l of links){const r=document.createElement('tr');
+    r.innerHTML=`<td>${esc(l.parent)}</td><td>${esc(l.child)}</td><td>${esc(l.callCount)}</td>
+      <td class="${l.errorCount?'err':''}">${esc(l.errorCount||0)}</td>`;t.append(r)}
+}
+async function pcts(){
+  try{
+    const rows=await get('/api/v2/tpu/percentiles?q=0.5,0.99');
+    const t=$('#pcttab');t.innerHTML='<tr><th>service</th><th>span</th><th>count</th><th>p50 µs</th><th>p99 µs</th></tr>';
+    for(const x of rows){const r=document.createElement('tr');
+      r.innerHTML=`<td>${esc(x.serviceName)}</td><td>${esc(x.spanName)}</td><td>${esc(x.count)}</td>
+        <td>${Math.round(x.quantiles['0.5'])}</td><td>${Math.round(x.quantiles['0.99'])}</td>`;t.append(r)}
+  }catch(e){$('#pcttab').innerHTML='<tr><td class="muted">TPU storage not enabled</td></tr>'}
+}
+boot();
+</script></body></html>
+"""

@@ -77,9 +77,17 @@ class TpuStorage(
             autocomplete_keys=autocomplete_keys,
         )
         self._pad = pad_to_multiple
-        # largest single device batch: bounded by the digest pending buffer
-        # (dynamic_update_slice of a batch bigger than it cannot trace)
-        self.max_batch = min(self.config.digest_buffer, 8192)
+        # largest single device batch AFTER padding: bounded by the digest
+        # pending buffer (dynamic_update_slice of a batch bigger than it
+        # cannot trace), rounded DOWN to a pad multiple so a padded chunk
+        # never exceeds the bound.
+        bound = min(self.config.digest_buffer, 8192)
+        self.max_batch = (bound // pad_to_multiple) * pad_to_multiple
+        if self.max_batch <= 0:
+            raise ValueError(
+                f"digest_buffer ({self.config.digest_buffer}) must be >= "
+                f"pad_to_multiple ({pad_to_multiple})"
+            )
         self._closed = False
 
     # -- SPI factories ---------------------------------------------------
