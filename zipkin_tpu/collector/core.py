@@ -136,10 +136,15 @@ class Collector:
         *,
         sampler: Optional[CollectorSampler] = None,
         metrics: Optional[CollectorMetrics] = None,
+        fast_ingest: bool = False,
     ) -> None:
         self.storage = storage
         self.sampler = sampler or CollectorSampler(1.0)
         self.metrics = metrics or CollectorMetrics()
+        # opt-in line-rate path: JSON v2 bytes go straight to the TPU
+        # store's native columnar parser, skipping Span objects and the
+        # raw-span archive (aggregates only — the v5e ingest headline)
+        self.fast_ingest = fast_ingest and hasattr(storage, "ingest_json_fast")
         self._consumer = storage.span_consumer()
 
     def accept_spans_bytes(
@@ -154,6 +159,20 @@ class Collector:
         """
         self.metrics.increment_messages()
         self.metrics.increment_bytes(len(data))
+        if self.fast_ingest and (
+            encoding is None or encoding is codec.Encoding.JSON_V2
+        ):
+            try:
+                if encoding is not None or codec.detect(data) is codec.Encoding.JSON_V2:
+                    result = self.storage.ingest_json_fast(data, self.sampler)
+                    if result is not None:
+                        accepted, sample_dropped = result
+                        self.metrics.increment_spans(accepted + sample_dropped)
+                        if sample_dropped:
+                            self.metrics.increment_spans_dropped(sample_dropped)
+                        return accepted
+            except ValueError:
+                pass  # fall through: the python codec owns error reporting
         try:
             spans = codec.decode_spans(data, encoding)
         except Exception as e:

@@ -96,6 +96,7 @@ class ZipkinServer:
             self.storage,
             sampler=CollectorSampler(self.config.sample_rate),
             metrics=self.metrics.for_transport("http"),
+            fast_ingest=self.config.tpu_fast_ingest,
         )
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None

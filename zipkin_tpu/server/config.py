@@ -64,6 +64,7 @@ class ServerConfig:
     # TPU aggregation tier
     tpu_devices: Optional[int] = None  # None = all visible
     tpu_batch_size: int = 8192
+    tpu_fast_ingest: bool = False  # line-rate JSON->device path, no archive
     tpu_checkpoint_dir: Optional[str] = None
 
     @staticmethod
@@ -90,5 +91,6 @@ class ServerConfig:
             self_tracing_sample_rate=_env_float("SELF_TRACING_SAMPLE_RATE", 1.0),
             tpu_devices=_env_int("TPU_DEVICES", 0) or None,
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
+            tpu_fast_ingest=_env_bool("TPU_FAST_INGEST", False),
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
         )

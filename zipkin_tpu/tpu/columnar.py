@@ -194,6 +194,105 @@ def _pad(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def pack_parsed(
+    parsed, vocab: Vocab, pad_to_multiple: int = 1024
+) -> SpanColumns:
+    """Columns from a native parse (zipkin_tpu.native.parse_spans) —
+    the fast ingest path: no Span objects, strings interned straight from
+    the wire-buffer slices.
+
+    Interning cost is the host bottleneck at line rate, so slices are
+    cached per-call by their raw bytes (names repeat heavily within a
+    batch) and the service/name/key lookups share one pass.
+    """
+    n = parsed.n
+    cap = _pad(n, pad_to_multiple)
+    data = parsed.data
+    mv = memoryview(data)
+
+    svc = np.zeros(cap, np.int32)
+    rsvc = np.zeros(cap, np.int32)
+    key = np.zeros(cap, np.int32)
+
+    if getattr(parsed, "svc_id", None) is not None:
+        # interning already happened inside the native parse
+        svc[:n] = parsed.svc_id[:n]
+        rsvc[:n] = parsed.rsvc_id[:n]
+        key[:n] = parsed.key_id[:n]
+        return _assemble(parsed, n, cap, svc, rsvc, key)
+
+    intern_svc = vocab.services.intern
+    intern_name = vocab.span_names.intern
+    key_id = vocab.key_id
+    scache: Dict[bytes, int] = {}
+    ncache: Dict[bytes, int] = {}
+    kcache: Dict[Tuple[int, int], int] = {}
+
+    soff, slen = parsed.svc_off, parsed.svc_len
+    roff, rlen = parsed.rsvc_off, parsed.rsvc_len
+    noff, nlen = parsed.name_off, parsed.name_len
+
+    def sid_of(off: int, ln: int) -> int:
+        if ln == 0:
+            return 0
+        raw = bytes(mv[off : off + ln])
+        got = scache.get(raw)
+        if got is None:
+            got = intern_svc(raw.decode("utf-8", "replace").lower())
+            scache[raw] = got
+        return got
+
+    for i in range(n):
+        s = sid_of(soff[i], slen[i])
+        svc[i] = s
+        rsvc[i] = sid_of(roff[i], rlen[i])
+        ln = nlen[i]
+        if ln:
+            raw = bytes(mv[noff[i] : noff[i] + ln])
+            nid = ncache.get(raw)
+            if nid is None:
+                nid = intern_name(raw.decode("utf-8", "replace").lower())
+                ncache[raw] = nid
+        else:
+            nid = 0
+        pair = (s, nid)
+        kid = kcache.get(pair)
+        if kid is None:
+            kid = key_id(s, nid)
+            kcache[pair] = kid
+        key[i] = kid
+
+    return _assemble(parsed, n, cap, svc, rsvc, key)
+
+
+def _assemble(parsed, n, cap, svc, rsvc, key) -> SpanColumns:
+    def padded(a: np.ndarray, dtype) -> np.ndarray:
+        out = np.zeros(cap, dtype)
+        out[:n] = a[:n]
+        return out
+
+    hi32 = _hash2_np(parsed.th0[:n], parsed.th1[:n])
+    trace_h = np.zeros(cap, _U32)
+    trace_h[:n] = _hash2_np(_hash2_np(parsed.tl0[:n], parsed.tl1[:n]), hi32)
+
+    valid = np.zeros(cap, bool)
+    valid[:n] = True
+    return SpanColumns(
+        trace_h=trace_h,
+        tl0=padded(parsed.tl0, _U32), tl1=padded(parsed.tl1, _U32),
+        s0=padded(parsed.s0, _U32), s1=padded(parsed.s1, _U32),
+        p0=padded(parsed.p0, _U32), p1=padded(parsed.p1, _U32),
+        shared=padded(parsed.shared, bool),
+        kind=padded(parsed.kind, np.int32),
+        svc=svc, rsvc=rsvc, key=key,
+        err=padded(parsed.err, bool),
+        dur=padded(parsed.dur_us, _U32),
+        has_dur=padded(parsed.has_dur, bool),
+        ts_min=padded((parsed.ts_us // 60_000_000).astype(_U32), _U32),
+        valid=valid,
+    )
+
+
 def pack_spans(
     spans: Sequence[Span], vocab: Vocab, pad_to_multiple: int = 1024
 ) -> SpanColumns:

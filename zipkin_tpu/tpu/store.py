@@ -23,6 +23,7 @@ double-count — the documented trade, testable against the oracle.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -44,6 +45,14 @@ from zipkin_tpu.tpu.columnar import SpanColumns, Vocab, pack_spans
 from zipkin_tpu.tpu.state import AggConfig
 from zipkin_tpu.utils.call import Call
 from zipkin_tpu.utils.component import CheckResult, Component
+
+
+_PARSED_FIELDS = (
+    "tl0", "tl1", "th0", "th1", "s0", "s1", "p0", "p1",
+    "shared", "kind", "err", "has_dur", "ts_us", "dur_us",
+    "debug", "svc_off", "svc_len", "rsvc_off", "rsvc_len",
+    "name_off", "name_len", "svc_id", "rsvc_id", "name_id", "key_id",
+)
 
 
 class TpuStorage(
@@ -81,7 +90,7 @@ class TpuStorage(
         # pending buffer (dynamic_update_slice of a batch bigger than it
         # cannot trace), rounded DOWN to a pad multiple so a padded chunk
         # never exceeds the bound.
-        bound = min(self.config.digest_buffer, 8192)
+        bound = min(self.config.digest_buffer, 16384)
         self.max_batch = (bound // pad_to_multiple) * pad_to_multiple
         if self.max_batch <= 0:
             raise ValueError(
@@ -89,6 +98,11 @@ class TpuStorage(
                 f"pad_to_multiple ({pad_to_multiple})"
             )
         self._closed = False
+        # interning id-space coherence: the C-side vocab (fast path) and
+        # the Python vocab (object path) assign ids sequentially; any
+        # operation that interns must hold this lock so the orders match.
+        self._intern_lock = threading.RLock()
+        self._nvocab = None
 
     # -- SPI factories ---------------------------------------------------
 
@@ -114,10 +128,69 @@ class TpuStorage(
             # chunk: a giant POST must not exceed the device batch bound
             # (state transitions serialize on the aggregator's own lock)
             for lo in range(0, len(spans), self.max_batch):
-                cols = pack_spans(spans[lo : lo + self.max_batch], self.vocab, self._pad)
+                with self._intern_lock:
+                    cols = pack_spans(
+                        spans[lo : lo + self.max_batch], self.vocab, self._pad
+                    )
                 self.agg.ingest(cols)
 
         return Call.of(run)
+
+    def ingest_json_fast(self, data: bytes, sampler=None):
+        """Line-rate ingest: raw JSON v2 bytes -> device aggregates via the
+        native columnar parser, skipping Span objects AND the host archive
+        (the aggregate tier is the product at this rate; raw-span retention
+        at line rate is delegated, as in the reference, to row storage).
+
+        Returns (accepted, sample_dropped), or None when the native path
+        can't take this payload (caller falls back to the object path).
+        """
+        from zipkin_tpu import native
+        from zipkin_tpu.tpu.columnar import pack_parsed
+
+        if not native.available():
+            return None
+        with self._intern_lock:
+            if self._nvocab is None:
+                self._nvocab = native.NativeVocab(self.vocab)
+            self._nvocab.ensure_synced()
+            parsed = native.parse_spans(data, nvocab=self._nvocab)
+            if parsed is None:
+                return None
+            self._nvocab.sync()
+        n = parsed.n
+        dropped = 0
+        if sampler is not None and sampler.rate < 1.0 and n:
+            lo = (parsed.tl1[:n].astype(np.uint64) << np.uint64(32)) | parsed.tl0[
+                :n
+            ].astype(np.uint64)
+            signed = lo.view(np.int64)
+            t = np.abs(signed)  # numpy abs(INT64_MIN) stays negative: Java parity
+            keep = (t <= sampler._boundary) | (parsed.debug[:n] != 0)
+            dropped = int(n - keep.sum())
+            if dropped:
+                idx = np.nonzero(keep)[0]
+                for field in _PARSED_FIELDS:
+                    col = getattr(parsed, field, None)
+                    if col is not None:
+                        setattr(parsed, field, col[:n][idx])
+                parsed.n = n = len(idx)
+        if n == 0:
+            return 0, dropped
+        for lo_i in range(0, n, self.max_batch):
+            hi_i = min(lo_i + self.max_batch, n)
+            if lo_i == 0 and hi_i == n:
+                sub = parsed
+            else:
+                sub = native.ParsedColumns()
+                sub.data = parsed.data
+                for f in _PARSED_FIELDS:
+                    col = getattr(parsed, f, None)
+                    setattr(sub, f, None if col is None else col[lo_i:hi_i])
+                sub.n = hi_i - lo_i
+            cols = pack_parsed(sub, self.vocab, self._pad)
+            self.agg.ingest(cols)
+        return n, dropped
 
     # -- raw trace reads: host archive -----------------------------------
 
