@@ -1,0 +1,142 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+- thrift ``_Reader.skip`` must bound attacker-controlled container counts
+  (a ~20-byte payload declaring ``list<bool>`` count=0x7FFFFFFF must fail
+  fast, not burn minutes of CPU);
+- ``ThrottledStorage`` must throttle the ``ingest_json_fast`` hot path,
+  not forward it unmetered via ``__getattr__``;
+- the sampler maps INT64_MIN to INT64_MAX (upstream CollectorSampler
+  parity) in both the scalar and numpy fast paths;
+- the native JSON parser tolerates payloads truncated mid-``null``.
+"""
+
+import struct
+import time
+
+import pytest
+
+from zipkin_tpu.model import thrift
+from zipkin_tpu.storage.memory import InMemoryStorage
+from zipkin_tpu.storage.throttle import RejectedExecutionError, ThrottledStorage
+
+
+class TestThriftSkipBounds:
+    def _payload_with_skipped_list(self, count: int) -> bytes:
+        # list<Span> header: element type STRUCT, 1 element; inside the
+        # span struct, an unknown field (id 99) of type LIST whose element
+        # type is BOOL and whose declared count is attacker-controlled.
+        return (
+            bytes([0x0C])
+            + struct.pack(">i", 1)
+            + bytes([15])  # field type LIST
+            + struct.pack(">h", 99)  # unknown field id -> skip()
+            + bytes([2])  # element type BOOL
+            + struct.pack(">i", count)
+            + bytes([0])  # struct STOP (never reached when count bogus)
+        )
+
+    def test_huge_declared_count_fails_fast(self):
+        data = self._payload_with_skipped_list(0x7FFFFFFF)
+        start = time.monotonic()
+        with pytest.raises(ValueError):
+            thrift.decode_span_list(data)
+        assert time.monotonic() - start < 1.0
+
+    def test_negative_count_rejected(self):
+        with pytest.raises(ValueError):
+            thrift.decode_span_list(self._payload_with_skipped_list(-1))
+
+    def test_honest_small_skip_still_works(self):
+        # 1-element bool list is genuinely present: skip succeeds, the
+        # struct's real id fields decode, no raise.
+        data = (
+            bytes([0x0C])
+            + struct.pack(">i", 1)
+            + bytes([10]) + struct.pack(">h", 1) + struct.pack(">q", 0xA)  # trace_id
+            + bytes([10]) + struct.pack(">h", 4) + struct.pack(">q", 0xB)  # id
+            + bytes([15])
+            + struct.pack(">h", 99)
+            + bytes([2])
+            + struct.pack(">i", 1)
+            + bytes([1])  # the bool element
+            + bytes([0])  # struct STOP
+        )
+        spans = thrift.decode_span_list(data)
+        assert len(spans) == 1
+        assert spans[0].id == "000000000000000b"
+
+    def test_truncated_scalar_skip_raises(self):
+        # unknown i64 field with only 2 bytes of payload left
+        data = (
+            bytes([0x0C])
+            + struct.pack(">i", 1)
+            + bytes([10])  # field type I64
+            + struct.pack(">h", 99)
+            + b"\x00\x00"
+        )
+        with pytest.raises((ValueError, struct.error, IndexError)):
+            thrift.decode_span_list(data)
+
+
+class _FastStorage(InMemoryStorage):
+    def __init__(self):
+        super().__init__()
+        self.fast_calls = 0
+
+    def ingest_json_fast(self, data: bytes, sampler=None):
+        self.fast_calls += 1
+        return 0, 0
+
+
+class TestThrottledFastIngest:
+    def test_fast_ingest_passes_through_when_unthrottled(self):
+        delegate = _FastStorage()
+        throttled = ThrottledStorage(delegate, max_concurrency=2, max_queue=2)
+        assert hasattr(throttled, "ingest_json_fast")
+        assert throttled.ingest_json_fast(b"[]") == (0, 0)
+        assert delegate.fast_calls == 1
+
+    def test_fast_ingest_rejected_when_queue_full(self):
+        delegate = _FastStorage()
+        throttled = ThrottledStorage(delegate, max_concurrency=1, max_queue=1)
+        # occupy the only queue slot so the next fast call must shed
+        assert throttled._throttle._queue_slots.acquire(blocking=False)
+        try:
+            with pytest.raises(RejectedExecutionError):
+                throttled.ingest_json_fast(b"[]")
+        finally:
+            throttled._throttle._queue_slots.release()
+        assert delegate.fast_calls == 0
+
+    def test_absent_on_plain_storage(self):
+        throttled = ThrottledStorage(InMemoryStorage())
+        assert not hasattr(throttled, "ingest_json_fast")
+
+
+class TestNumpySamplerParity:
+    def test_min_value_dropped_in_fast_path(self):
+        import numpy as np
+
+        from zipkin_tpu.collector.core import CollectorSampler
+
+        # the numpy expression used by TpuStorage.ingest_json_fast
+        signed = np.array([-(1 << 63), 1, -5], dtype=np.int64)
+        t = np.abs(signed)
+        t = np.where(t == np.iinfo(np.int64).min, np.iinfo(np.int64).max, t)
+        s = CollectorSampler(0.5)
+        keep = t <= s._boundary
+        assert not keep[0]  # MIN_VALUE dropped below rate 1.0
+        assert keep[1] and keep[2]
+        # scalar path agrees
+        assert not s.is_sampled(1 << 63)
+
+
+class TestNativeTruncatedNull:
+    def test_payload_truncated_mid_null_endpoint(self):
+        from zipkin_tpu import native
+
+        if not native.available():
+            pytest.skip("native codec unavailable")
+        base = b'[{"traceId":"000000000000000a","id":"000000000000000b","localEndpoint":n'
+        # parser must fail cleanly (None -> python fallback), not read OOB
+        assert native.parse_spans(base) is None

@@ -21,12 +21,14 @@ from zipkin_tpu.utils.call import Call
 
 class TestSamplerEdge:
     def test_long_min_value_is_sampled_at_rate_1(self):
-        # trace id low64 == 0x8000...0 -> Java Math.abs stays negative and
-        # passes; our arithmetic must match (mixed-fleet consistency).
         assert CollectorSampler(1.0).is_sampled(1 << 63)
 
-    def test_long_min_value_sampled_at_any_rate(self):
-        assert CollectorSampler(0.001).is_sampled(1 << 63)
+    def test_long_min_value_dropped_below_rate_1(self):
+        # trace id low64 == 0x8000...0: upstream CollectorSampler maps
+        # Long.MIN_VALUE to Long.MAX_VALUE before comparing, so it drops at
+        # any rate < 1.0 (mixed-fleet consistency).
+        assert not CollectorSampler(0.001).is_sampled(1 << 63)
+        assert not CollectorSampler(0.999999).is_sampled(1 << 63)
 
     def test_boundary_consistency(self):
         s = CollectorSampler(0.5)

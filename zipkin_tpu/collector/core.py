@@ -49,10 +49,10 @@ class CollectorSampler:
         if debug:
             return True
         signed = trace_id_low64 - (1 << 64) if trace_id_low64 >= (1 << 63) else trace_id_low64
-        # Java parity: Math.abs(Long.MIN_VALUE) stays negative, so that one
-        # id always passes `t <= boundary`; Python abs() would overflow to
-        # 2**63 and wrongly drop it even at rate 1.0.
-        t = signed if signed == -(1 << 63) else abs(signed)
+        # Java parity: CollectorSampler explicitly maps Long.MIN_VALUE to
+        # Long.MAX_VALUE before comparing (abs() alone would overflow), so
+        # that one id is dropped at rates < 1.0 like any max-magnitude id.
+        t = _MAX_I64 if signed == -(1 << 63) else abs(signed)
         return t <= self._boundary
 
     def test(self, span: Span) -> bool:
@@ -162,6 +162,8 @@ class Collector:
         if self.fast_ingest and (
             encoding is None or encoding is codec.Encoding.JSON_V2
         ):
+            from zipkin_tpu.storage.throttle import RejectedExecutionError
+
             try:
                 if encoding is not None or codec.detect(data) is codec.Encoding.JSON_V2:
                     result = self.storage.ingest_json_fast(data, self.sampler)
@@ -171,6 +173,11 @@ class Collector:
                         if sample_dropped:
                             self.metrics.increment_spans_dropped(sample_dropped)
                         return accepted
+            except RejectedExecutionError:
+                # load shed on the fast path must show up on the same drop
+                # counters the object path maintains, or dashboards go blind
+                self.metrics.increment_messages_dropped()
+                raise
             except ValueError:
                 pass  # fall through: the python codec owns error reporting
         try:

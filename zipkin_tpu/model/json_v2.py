@@ -9,7 +9,7 @@ is always canonical.
 
 The reference hand-rolls a streaming writer for speed; here the oracle path
 uses the stdlib json module, and the throughput path decodes straight into
-columnar arrays (:mod:`zipkin_tpu.model.columnar`) instead of objects — the
+columnar arrays (:mod:`zipkin_tpu.tpu.columnar`) instead of objects — the
 TPU-native answer to ``WriteBuffer``.
 """
 

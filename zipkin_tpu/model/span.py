@@ -16,7 +16,7 @@ are canonical everywhere downstream):
 
 These are plain frozen dataclasses — the row-oriented form used by codecs,
 the oracle store, and tests. The TPU ingest path uses the columnar
-struct-of-arrays form in :mod:`zipkin_tpu.model.columnar` instead.
+struct-of-arrays form in :mod:`zipkin_tpu.tpu.columnar` instead.
 """
 
 from __future__ import annotations

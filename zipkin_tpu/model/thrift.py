@@ -78,6 +78,20 @@ class _Reader:
         self.pos += n
         return out
 
+    def _count(self) -> int:
+        """Container element count, validated against the readable buffer.
+
+        Attacker-controlled i32 counts (up to 2^31) must be bounded by the
+        bytes remaining — every element occupies >= 1 byte — or a ~20-byte
+        payload declaring ``list<bool>`` count=0x7FFFFFFF burns minutes of
+        CPU per request. Mirrors ThriftCodec's guard of lengths against the
+        readable buffer (SURVEY.md §2.1).
+        """
+        n = self.i32()
+        if n < 0 or n > len(self.data) - self.pos:
+            raise ValueError("thrift container count exceeds buffer")
+        return n
+
     def skip(self, ttype: int) -> None:
         if ttype in (_T_BOOL, _T_BYTE):
             self.pos += 1
@@ -98,15 +112,17 @@ class _Reader:
                 self.skip(ft)
         elif ttype in (_T_LIST, _T_SET):
             et = self.u8()
-            for _ in range(self.i32()):
+            for _ in range(self._count()):
                 self.skip(et)
         elif ttype == _T_MAP:
             kt, vt = self.u8(), self.u8()
-            for _ in range(self.i32()):
+            for _ in range(self._count()):
                 self.skip(kt)
                 self.skip(vt)
         else:
             raise ValueError(f"unknown thrift type {ttype}")
+        if self.pos > len(self.data):
+            raise ValueError("truncated thrift payload")
 
 
 def _read_endpoint(r: _Reader) -> Optional[Endpoint]:
@@ -213,13 +229,13 @@ def _read_v1_span(r: _Reader) -> V1Span:
             parent_id = r.i64()
         elif fid == 6 and ftype == _T_LIST:
             r.u8()  # element type (struct)
-            for _ in range(r.i32()):
+            for _ in range(r._count()):
                 ann = _read_annotation(r)
                 if ann is not None:
                     annotations.append(ann)
         elif fid == 8 and ftype == _T_LIST:
             r.u8()
-            for _ in range(r.i32()):
+            for _ in range(r._count()):
                 b = _read_binary_annotation(r)
                 if b is not None:
                     binary.append(b)
@@ -256,7 +272,7 @@ def decode_span_list(data: bytes) -> List[Span]:
     etype = r.u8()
     if etype != _T_STRUCT:
         raise ValueError("expected thrift list of structs")
-    count = r.i32()
+    count = r._count()
     v1_spans = [_read_v1_span(r) for _ in range(count)]
     return convert_v1_spans(v1_spans)
 

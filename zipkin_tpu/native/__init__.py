@@ -70,7 +70,26 @@ def _load():
         if so_path is None:
             _build_failed = True
             return None
-        lib = ctypes.CDLL(so_path)
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            # A stale cached .so (e.g. built on a different arch/libc)
+            # would otherwise disable the native codec forever, since the
+            # source digest still matches. Evict it and rebuild once.
+            try:
+                os.unlink(so_path)
+            except OSError:
+                pass
+            so_path = _compile()
+            if so_path is None:
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(so_path)
+            except OSError as e:
+                logger.warning("native codec load failed (%s); disabled", e)
+                _build_failed = True
+                return None
         u32p = ctypes.POINTER(ctypes.c_uint32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u64p = ctypes.POINTER(ctypes.c_uint64)

@@ -200,7 +200,7 @@ static int key_is(const uint8_t *buf, uint32_t off, uint32_t len,
 static int read_endpoint(cursor_t *c, uint32_t *soff, uint32_t *slen) {
   *soff = 0; *slen = 0;
   skip_ws(c);
-  if (c->pos < c->n && memcmp(c->buf + c->pos, "null", 4) == 0) {
+  if (c->pos + 4 <= c->n && memcmp(c->buf + c->pos, "null", 4) == 0) {
     c->pos += 4;
     return 0;
   }

@@ -19,7 +19,7 @@ one scatter-add. Cross-shard reads merge by concatenating centroid lists
 and re-compacting (:func:`merge`).
 
 Accuracy: with C=64 centroids, tail quantiles (p99) land within ~0.5% of
-exact on 1M-point streams (see tests/test_ops_tdigest.py), comfortably
+exact on 1M-point streams (see tests/test_ops_sketches.py), comfortably
 inside BASELINE config[1]'s epsilon.
 """
 

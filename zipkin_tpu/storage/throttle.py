@@ -83,7 +83,19 @@ class ThrottledStorage(StorageComponent):
         # storage in the throttle doesn't hide its extra read surface.
         if name == "delegate":  # not yet set during __init__
             raise AttributeError(name)
-        return getattr(self.delegate, name)
+        attr = getattr(self.delegate, name)
+        if name == "ingest_json_fast":
+            # The collector probes hasattr(storage, "ingest_json_fast") and
+            # then bypasses span_consumer() — the fast hot path must still
+            # pay the limiter or TPU_FAST_INGEST + STORAGE_THROTTLE_ENABLED
+            # silently disables backpressure.
+            throttle = self._throttle
+
+            def _throttled_fast(*args, **kwargs):
+                return throttle.run(lambda: attr(*args, **kwargs))
+
+            return _throttled_fast
+        return attr
 
     def span_consumer(self) -> SpanConsumer:
         inner = self.delegate.span_consumer()

@@ -165,7 +165,10 @@ class TpuStorage(
                 :n
             ].astype(np.uint64)
             signed = lo.view(np.int64)
-            t = np.abs(signed)  # numpy abs(INT64_MIN) stays negative: Java parity
+            # numpy abs(INT64_MIN) overflows back to INT64_MIN (negative);
+            # Java parity maps MIN_VALUE -> MAX_VALUE so it drops at <1.0.
+            t = np.abs(signed)
+            t = np.where(t == np.iinfo(np.int64).min, np.iinfo(np.int64).max, t)
             keep = (t <= sampler._boundary) | (parsed.debug[:n] != 0)
             dropped = int(n - keep.sum())
             if dropped:
