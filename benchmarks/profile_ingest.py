@@ -1,0 +1,118 @@
+"""Segment-level profile of the fast ingest path on the current backend.
+
+Times each stage of ``TpuStorage.ingest_json_fast`` in isolation —
+native parse+intern, columnar pack, device_put, jit'd step (blocked),
+digest flush — and prints a per-stage µs/span table plus the implied
+serial vs overlapped throughput. This is the evidence for where the
+next perf dollar goes (VERDICT round-1 item 2).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu import native
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.parallel.mesh import make_mesh
+    from zipkin_tpu.parallel.sharded import ShardedAggregator
+    from zipkin_tpu.tpu.columnar import Vocab, pack_parsed
+    from zipkin_tpu.tpu.state import AggConfig
+    from zipkin_tpu.tpu.store import TpuStorage
+
+    assert native.available()
+    batch = 8192
+    reps = 24
+
+    config = AggConfig()
+    store = TpuStorage(config=config, mesh=make_mesh(1), pad_to_multiple=batch)
+    spans = lots_of_spans(65536, seed=7, services=40, span_names=120)
+    payloads = [
+        json_v2.encode_span_list(spans[i : i + batch])
+        for i in range(0, len(spans), batch)
+    ]
+
+    # warm: intern vocab + compile
+    store.ingest_json_fast(payloads[0])
+    store.agg.block_until_ready()
+
+    def timeit(fn, n=reps):
+        t0 = time.perf_counter()
+        for i in range(n):
+            fn(i)
+        return (time.perf_counter() - t0) / n
+
+    # 1) native parse + intern
+    nv = store._nvocab
+    t_parse = timeit(lambda i: native.parse_spans(payloads[i % len(payloads)], nvocab=nv))
+
+    parsed = [native.parse_spans(p, nvocab=nv) for p in payloads]
+
+    # 2) pack_parsed
+    t_pack = timeit(lambda i: pack_parsed(parsed[i % len(parsed)], store.vocab, batch))
+
+    cols = [pack_parsed(p, store.vocab, batch) for p in parsed]
+    agg = store.agg
+    from zipkin_tpu.tpu.columnar import SpanColumns
+
+    routed = [SpanColumns(*(f[None] for f in c)) for c in cols]
+
+    # 3) device_put
+    t_put = timeit(lambda i: jax.block_until_ready(
+        jax.device_put(routed[i % len(routed)], agg._sharding)))
+
+    on_dev = [jax.device_put(r, agg._sharding) for r in routed]
+
+    # 4) step, fully blocked each iteration (includes occasional flush)
+    def stepped(i):
+        agg.state = agg._step(agg.state, on_dev[i % len(on_dev)])
+        jax.block_until_ready(agg.state.counters)
+
+    t_step = timeit(stepped)
+
+    # 4b) step WITHOUT the digest pending path hitting flush: measure a
+    # fresh aggregator for the first 7 batches only (buffer 64k / 8k = 8)
+    agg2 = ShardedAggregator(config, mesh=make_mesh(1))
+    agg2.state = agg2._step(agg2.state, on_dev[0])
+    jax.block_until_ready(agg2.state.counters)
+    t_step_noflush = timeit(
+        lambda i: (
+            setattr(agg2, "state", agg2._step(agg2.state, on_dev[(i % 6) + 1])),
+            jax.block_until_ready(agg2.state.counters),
+        ),
+        n=6,
+    )
+
+    # 5) flush alone
+    t0 = time.perf_counter()
+    agg.state = agg._flush(agg.state)
+    jax.block_until_ready(agg.state.digest)
+    t_flush = time.perf_counter() - t0
+
+    us = lambda t: t / batch * 1e6
+    rows = {
+        "parse_us_per_span": round(us(t_parse), 3),
+        "pack_us_per_span": round(us(t_pack), 3),
+        "device_put_us_per_span": round(us(t_put), 3),
+        "step_blocked_us_per_span": round(us(t_step), 3),
+        "step_noflush_us_per_span": round(us(t_step_noflush), 3),
+        "flush_once_ms": round(t_flush * 1e3, 2),
+        "host_us_per_span": round(us(t_parse + t_pack + t_put), 3),
+        "serial_spans_per_sec": round(batch / (t_parse + t_pack + t_put + t_step), 1),
+        "overlap_bound_spans_per_sec": round(
+            batch / max(t_parse + t_pack + t_put, t_step), 1
+        ),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(rows, indent=1))
+
+
+if __name__ == "__main__":
+    main()

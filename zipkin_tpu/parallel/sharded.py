@@ -21,10 +21,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zipkin_tpu.ops import linker as dlink
 from zipkin_tpu.tpu import ingest as ing
-from zipkin_tpu.tpu.columnar import SpanColumns, empty_columns
+from zipkin_tpu.tpu.columnar import SpanColumns, empty_columns, fuse_columns
 from zipkin_tpu.tpu.state import AggConfig, AggState, init_state
 
 SHARD_AXIS = "shard"
+
+
+def unfuse_columns(fz: jnp.ndarray) -> SpanColumns:
+    """Device-side inverse of :func:`zipkin_tpu.tpu.columnar.fuse_columns`:
+    ``[F, n] u32`` -> typed SpanColumns (free bitcasts / compares)."""
+    rows = {name: fz[i] for i, name in enumerate(SpanColumns._fields)}
+    as_i32 = lambda a: jax.lax.bitcast_convert_type(a, jnp.int32)
+    as_bool = lambda a: a != 0
+    return SpanColumns(
+        trace_h=rows["trace_h"], tl0=rows["tl0"], tl1=rows["tl1"],
+        s0=rows["s0"], s1=rows["s1"], p0=rows["p0"], p1=rows["p1"],
+        shared=as_bool(rows["shared"]), kind=as_i32(rows["kind"]),
+        svc=as_i32(rows["svc"]), rsvc=as_i32(rows["rsvc"]),
+        key=as_i32(rows["key"]), err=as_bool(rows["err"]),
+        dur=rows["dur"], has_dur=as_bool(rows["has_dur"]),
+        ts_min=rows["ts_min"], valid=as_bool(rows["valid"]),
+    )
 
 
 def route_columns(
@@ -67,10 +84,10 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
 
     one = functools.partial(ing.ingest_step, config)
 
-    def spmd_step(state: AggState, batch: SpanColumns) -> AggState:
+    def spmd_step(state: AggState, fused: jnp.ndarray) -> AggState:
         squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
         expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
-        return expand(one(squeeze(state), squeeze(batch)))
+        return expand(one(squeeze(state), unfuse_columns(fused[0])))
 
     step = jax.jit(
         shard_map(
@@ -155,12 +172,13 @@ class ShardedAggregator:
     # -- write path ------------------------------------------------------
 
     def ingest(self, cols: SpanColumns) -> None:
-        """Route one host batch across shards and fold it in."""
+        """Route one host batch across shards and fold it in (the batch
+        ships as one fused u32 array — one transfer, not 17)."""
         if self.n_shards == 1:
-            routed = SpanColumns(*(f[None] for f in cols))
+            fused = fuse_columns(cols)[None]
         else:
-            routed = route_columns(cols, self.n_shards)
-        device_batch = jax.device_put(routed, self._sharding)
+            fused = fuse_columns(route_columns(cols, self.n_shards))
+        device_batch = jax.device_put(fused, self._sharding)
         with self.lock:
             self.state = self._step(self.state, device_batch)
             c = self.host_counters

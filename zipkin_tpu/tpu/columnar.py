@@ -175,6 +175,25 @@ class SpanColumns(NamedTuple):
         return SpanColumns(*(np.concatenate([a, b]) for a, b in zip(self, other)))
 
 
+def fuse_columns(cols: SpanColumns) -> np.ndarray:
+    """One contiguous u32 image of a batch: ``[..., len(fields), n]``.
+
+    Host->device transfer cost on a tunneled PJRT backend is dominated by
+    per-array dispatch overhead (17 small transfers per batch), so the
+    whole batch ships as ONE uint32 array and is re-typed on device by
+    :func:`zipkin_tpu.parallel.sharded.unfuse_columns` (i32 fields travel
+    bit-cast, bools as 0/1). Accepts per-shard stacked fields (leading
+    axes are preserved).
+    """
+    fields = list(cols)
+    lead = fields[0].shape[:-1]
+    n = fields[0].shape[-1]
+    out = np.empty(lead + (len(fields), n), np.uint32)
+    for i, col in enumerate(fields):
+        out[..., i, :] = col.view(np.uint32) if col.dtype == np.int32 else col
+    return out
+
+
 def empty_columns(n: int) -> SpanColumns:
     z32 = np.zeros(n, _U32)
     return SpanColumns(

@@ -102,10 +102,19 @@ def _flush_pending_digest(
     config: AggConfig, digest: jnp.ndarray, pend_key: jnp.ndarray, pend_val: jnp.ndarray
 ):
     """Compact the whole pending buffer into the digests (empty lanes have
-    key -1 -> weight 0)."""
+    key -1 -> weight 0).
+
+    Split formulation: sort ONLY the pending points into per-key partial
+    digests, then fold them in with a row-parallel merge. The round-1
+    joint formulation re-sorted all K*C existing centroid lanes every
+    flush and dominated the ingest step (66% of device time in the
+    profiler capture — see PROFILE_r02.md)."""
     w = (pend_key >= 0).astype(jnp.float32)
     keys = jnp.clip(pend_key, 0, config.max_keys - 1)
-    return tdigest.update(digest, keys, pend_val, w)
+    partial = tdigest.compact_points(
+        keys, pend_val, w, config.max_keys, config.digest_centroids
+    )
+    return tdigest.row_merge(digest, partial)
 
 
 def _digest_buffered_update(

@@ -44,8 +44,10 @@ class AggConfig:
     # t-digest pending buffer: batches append here (cheap) and the big
     # sort-based compaction runs only when it fills — the classic digest
     # buffering trade, amortizing the K*C-point sort across many batches.
-    # Must be >= the largest packed batch size.
-    digest_buffer: int = 1 << 16
+    # Must be >= the largest packed batch size. 128k lanes halve the
+    # per-span compaction cost vs 64k (the sort is dominated by the
+    # K*C existing-centroid lanes, so a bigger buffer is nearly free).
+    digest_buffer: int = 1 << 17
     ring_capacity: int = 1 << 17  # spans retained per shard for linking
 
     @property

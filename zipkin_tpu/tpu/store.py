@@ -90,7 +90,11 @@ class TpuStorage(
         # pending buffer (dynamic_update_slice of a batch bigger than it
         # cannot trace), rounded DOWN to a pad multiple so a padded chunk
         # never exceeds the bound.
-        bound = min(self.config.digest_buffer, 16384)
+        # Dispatch on the tunneled PJRT backend carries a large fixed
+        # latency, so bigger device batches win nearly linearly; the only
+        # hard bound is the digest pending buffer (dynamic_update_slice of
+        # a batch bigger than it cannot trace).
+        bound = min(self.config.digest_buffer, 65536)
         self.max_batch = (bound // pad_to_multiple) * pad_to_multiple
         if self.max_batch <= 0:
             raise ValueError(
