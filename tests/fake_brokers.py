@@ -1,0 +1,208 @@
+"""In-memory fakes of the broker client libraries (kafka-python, pika,
+stomp.py), installed via ``sys.modules`` so the real ``KafkaSource`` /
+``RabbitMQSource`` / ``ActiveMQSource`` classes execute under test — the
+role the reference's testcontainers single-node brokers play for
+``KafkaCollector``/``RabbitMQCollector``/``ActiveMQCollector`` ITs
+(SURVEY.md §2.2, §4).
+
+Each fake records exactly what a correctness argument about the commit
+discipline needs: which offsets/tags were committed/acked and when.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from collections import namedtuple
+from contextlib import contextmanager
+
+# -- kafka-python ----------------------------------------------------------
+
+TopicPartition = namedtuple("TopicPartition", ["topic", "partition"])
+OffsetAndMetadata = namedtuple("OffsetAndMetadata", ["offset", "metadata"])
+ConsumerRecord = namedtuple("ConsumerRecord", ["topic", "partition", "offset", "value"])
+
+
+class FakeKafkaConsumer:
+    """Per-partition record queues; poll interleaves partitions the way a
+    real consumer's fetcher does (round-robin across owned partitions)."""
+
+    instances: list = []
+
+    def __init__(self, *topics, bootstrap_servers=None, group_id=None,
+                 enable_auto_commit=True, **_kw):
+        assert enable_auto_commit is False, "source must manage offsets itself"
+        self.topics = topics
+        self.bootstrap_servers = bootstrap_servers
+        self.group_id = group_id
+        self._queues: dict = {}  # TopicPartition -> list[ConsumerRecord]
+        self.committed: dict = {}  # TopicPartition -> OffsetAndMetadata
+        self.commit_calls: list = []
+        self.closed = False
+        FakeKafkaConsumer.instances.append(self)
+
+    # test seam
+    def feed(self, partition: int, value: bytes, topic: str = "zipkin"):
+        tp = TopicPartition(topic, partition)
+        q = self._queues.setdefault(tp, [])
+        offset = len(q)
+        q.append(ConsumerRecord(topic, partition, offset, value))
+
+    def poll(self, timeout_ms=0, max_records=None):
+        out: dict = {}
+        budget = max_records if max_records is not None else 1 << 30
+        for tp, q in self._queues.items():
+            take = q[:budget]
+            if take:
+                out[tp] = take
+                self._queues[tp] = q[len(take):]
+                budget -= len(take)
+            if budget <= 0:
+                break
+        return out
+
+    def commit(self, offsets=None):
+        assert offsets is not None, "source must commit explicit offsets"
+        self.commit_calls.append(dict(offsets))
+        self.committed.update(offsets)
+
+    def close(self):
+        self.closed = True
+
+
+# -- pika ------------------------------------------------------------------
+
+
+class FakeBlockingChannel:
+    def __init__(self):
+        self._pending: list = []  # (delivery_tag, body)
+        self._next_tag = 1  # rabbit delivery tags start at 1
+        self.acks: list = []  # (delivery_tag, multiple)
+
+    def feed(self, body: bytes):
+        self._pending.append(body)
+
+    def basic_get(self, queue):
+        if not self._pending:
+            return None, None, None
+        body = self._pending.pop(0)
+        method = types.SimpleNamespace(delivery_tag=self._next_tag)
+        self._next_tag += 1
+        return method, None, body
+
+    def basic_ack(self, delivery_tag, multiple=False):
+        self.acks.append((delivery_tag, multiple))
+
+
+class FakeBlockingConnection:
+    instances: list = []
+
+    def __init__(self, params):
+        self.params = params
+        self._channel = FakeBlockingChannel()
+        self.closed = False
+        FakeBlockingConnection.instances.append(self)
+
+    def channel(self):
+        return self._channel
+
+    def close(self):
+        self.closed = True
+
+
+class FakeURLParameters:
+    def __init__(self, uri):
+        self.uri = uri
+
+
+# -- stomp.py --------------------------------------------------------------
+
+
+class FakeStompFrame:
+    def __init__(self, body: str, headers: dict):
+        self.body = body
+        self.headers = headers
+
+
+class FakeStompConnection:
+    instances: list = []
+
+    def __init__(self, hosts):
+        self.hosts = hosts
+        self._listeners: dict = {}
+        self.connected = False
+        self.subscriptions: list = []
+        self.acked: list = []
+        self._next_ack = 0
+        FakeStompConnection.instances.append(self)
+
+    def set_listener(self, name, listener):
+        self._listeners[name] = listener
+
+    def connect(self, wait=False):
+        self.connected = True
+
+    def subscribe(self, destination, id=None, ack=None):
+        self.subscriptions.append((destination, id, ack))
+
+    # test seam: deliver one frame to every listener with a fresh ack id
+    def deliver(self, body: str):
+        ack_id = f"ack-{self._next_ack}"
+        self._next_ack += 1
+        frame = FakeStompFrame(body, {"ack": ack_id, "message-id": f"m-{ack_id}"})
+        for listener in self._listeners.values():
+            listener.on_message(frame)
+        return ack_id
+
+    def ack(self, ack_id):
+        self.acked.append(ack_id)
+
+    def disconnect(self):
+        self.connected = False
+
+
+class _FakeStompListener:  # base class the source subclasses
+    pass
+
+
+def _module(name: str, **attrs) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    return mod
+
+
+@contextmanager
+def installed():
+    """Install all three fakes into sys.modules; restore on exit."""
+    mods = {
+        "kafka": _module(
+            "kafka",
+            KafkaConsumer=FakeKafkaConsumer,
+            TopicPartition=TopicPartition,
+            OffsetAndMetadata=OffsetAndMetadata,
+        ),
+        "pika": _module(
+            "pika",
+            BlockingConnection=FakeBlockingConnection,
+            URLParameters=FakeURLParameters,
+        ),
+        "stomp": _module(
+            "stomp",
+            Connection=FakeStompConnection,
+            ConnectionListener=_FakeStompListener,
+        ),
+    }
+    saved = {name: sys.modules.get(name) for name in mods}
+    sys.modules.update(mods)
+    FakeKafkaConsumer.instances.clear()
+    FakeBlockingConnection.instances.clear()
+    FakeStompConnection.instances.clear()
+    try:
+        yield mods
+    finally:
+        for name, old in saved.items():
+            if old is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = old

@@ -176,8 +176,13 @@ def append_replay(path: str, payloads: Sequence[bytes]) -> None:
 class KafkaSource(MessageSource):
     """Kafka consumer over kafka-python, if installed.
 
-    Mirrors ``KafkaCollectorWorker``'s poll loop; offsets commit through
-    the consumer group (at-least-once).
+    Mirrors ``KafkaCollectorWorker``'s poll loop. Kafka offsets are per
+    partition, but the collector's watermark is a single cumulative
+    sequence — so this source numbers polled records with its own
+    monotonic sequence and, on ``commit(watermark)``, commits per
+    partition the highest record offset at or below the watermark
+    (+1 = Kafka's next-to-consume convention). At-least-once: nothing
+    commits until the collector marks the message stored.
     """
 
     def __init__(
@@ -187,33 +192,47 @@ class KafkaSource(MessageSource):
         group_id: str = "zipkin",
     ) -> None:
         try:
-            from kafka import KafkaConsumer  # type: ignore
+            from kafka import KafkaConsumer, OffsetAndMetadata  # type: ignore
         except ImportError as e:  # pragma: no cover - not in this image
             raise RuntimeError(
                 "kafka-python is not installed; use ReplayFileSource or "
                 "QueueSource, or install kafka-python"
             ) from e
-        self._consumer = KafkaConsumer(  # pragma: no cover
+        self._offset_meta = OffsetAndMetadata
+        self._consumer = KafkaConsumer(
             topic,
             bootstrap_servers=bootstrap_servers.split(","),
             group_id=group_id,
             enable_auto_commit=False,
         )
+        self._seq = 0
+        self._pending: dict = {}  # seq -> (TopicPartition, kafka offset)
 
-    def poll(self, max_messages, timeout):  # pragma: no cover
+    def poll(self, max_messages, timeout):
         records = self._consumer.poll(
             timeout_ms=int(timeout * 1000), max_records=max_messages
         )
         out = []
-        for batch in records.values():
+        for tp, batch in records.items():
             for r in batch:
-                out.append(Message(r.value, r.offset))
+                self._pending[self._seq] = (tp, r.offset)
+                out.append(Message(r.value, self._seq, meta=(tp, r.offset)))
+                self._seq += 1
         return out
 
-    def commit(self, offset) -> None:  # pragma: no cover
-        self._consumer.commit()
+    def commit(self, offset) -> None:
+        ready = [s for s in self._pending if s <= offset]
+        if not ready:
+            return
+        per_tp: dict = {}
+        for s in ready:
+            tp, koff = self._pending.pop(s)
+            per_tp[tp] = max(per_tp.get(tp, -1), koff)
+        self._consumer.commit(
+            {tp: self._offset_meta(koff + 1, None) for tp, koff in per_tp.items()}
+        )
 
-    def close(self) -> None:  # pragma: no cover
+    def close(self) -> None:
         self._consumer.close()
 
 
