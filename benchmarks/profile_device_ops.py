@@ -1,0 +1,77 @@
+"""Device-op profile of the ingest step + digest flush (jax.profiler).
+
+Captures an XPlane trace of N steady-state ingest steps and one pending-
+digest flush, then names the top device ops by total time — the
+"where does the device time go" evidence for PROFILE_r02.md.
+
+Run from the repo root: ``python -m benchmarks.profile_device_ops``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+
+def main() -> None:
+    import jax
+
+    from benchmarks.xplane_tools import latest_xspace, top_ops
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.parallel.mesh import make_mesh
+    from zipkin_tpu.tpu.state import AggConfig
+    from zipkin_tpu.tpu.store import TpuStorage
+
+    batch = int(os.environ.get("PROFILE_BATCH", 65_536))
+    steps = int(os.environ.get("PROFILE_STEPS", 8))
+
+    config = AggConfig()
+    store = TpuStorage(config=config, mesh=make_mesh(1), pad_to_multiple=batch)
+    spans = lots_of_spans(131_072, seed=7, services=40, span_names=120)
+    payloads = [
+        json_v2.encode_span_list(spans[i : i + batch])
+        for i in range(0, len(spans), batch)
+    ]
+
+    store.ingest_json_fast(payloads[0])  # warm: intern + compile
+    store.agg.block_until_ready()
+
+    trace_dir = tempfile.mkdtemp(prefix="ingest_trace_")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for i in range(steps):
+            store.ingest_json_fast(payloads[i % len(payloads)])
+        store.agg.block_until_ready()
+        # one explicit flush so the compaction shows up distinctly
+        store.agg.state = store.agg._flush(store.agg.state)
+        store.agg.block_until_ready()
+    wall = time.perf_counter() - t0
+
+    space = latest_xspace(trace_dir)
+    rows = [
+        {"op": name, "total_us": round(us, 1), "count": n, "share": round(share, 4)}
+        for name, us, n, share in top_ops(space, k=20)
+    ]
+    print(
+        json.dumps(
+            {
+                "platform": jax.devices()[0].platform,
+                "batch": batch,
+                "steps": steps,
+                "spans": steps * batch,
+                "wall_s": round(wall, 3),
+                "spans_per_sec": round(steps * batch / wall, 1),
+                "top_device_ops": rows,
+            },
+            indent=1,
+        )
+    )
+    shutil.rmtree(trace_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

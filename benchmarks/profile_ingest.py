@@ -74,15 +74,20 @@ def main() -> None:
 
     on_dev = [jax.device_put(r, agg._sharding) for r in routed]
 
-    # 4) step, fully blocked each iteration (includes occasional flush)
+    # 4) raw step, fully blocked each iteration. NOTE: the flush no longer
+    # runs inside the step (host-dispatched since r2); driving _step
+    # directly past the pending buffer would clamp, so reset periodically.
     def stepped(i):
+        if agg._pend_lanes + batch > config.digest_buffer:
+            agg.state = agg._flush(agg.state)
+            agg._pend_lanes = 0
         agg.state = agg._step(agg.state, on_dev[i % len(on_dev)])
+        agg._pend_lanes += batch
         jax.block_until_ready(agg.state.counters)
 
     t_step = timeit(stepped)
 
-    # 4b) step WITHOUT the digest pending path hitting flush: measure a
-    # fresh aggregator for the first 7 batches only (buffer 64k / 8k = 8)
+    # 4b) step alone on a fresh aggregator, no flush interleaved
     agg2 = ShardedAggregator(config, mesh=make_mesh(1))
     agg2.state = agg2._step(agg2.state, on_dev[0])
     jax.block_until_ready(agg2.state.counters)

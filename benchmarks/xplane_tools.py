@@ -1,0 +1,158 @@
+"""Minimal XPlane trace reader for ``jax.profiler`` captures.
+
+TensorFlow in this image ships no ``xplane_pb2``, so we carry the public,
+stable XPlane schema (tensorflow/tsl ``profiler/protobuf/xplane.proto``)
+and compile it on demand with the baked-in ``protoc``. Used by
+``profile_device_ops.py`` to name the top device ops behind the ingest
+step — the evidence artifact VERDICT round-1 item 2 requires.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import os
+import subprocess
+import sys
+import tempfile
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+_XPLANE_PROTO = """
+syntax = "proto3";
+package zipkin_tpu_profiler;
+
+message XSpace {
+  repeated XPlane planes = 1;
+  repeated string errors = 2;
+  repeated string warnings = 3;
+  repeated string hostnames = 4;
+}
+
+message XPlane {
+  int64 id = 1;
+  string name = 2;
+  repeated XLine lines = 3;
+  map<int64, XEventMetadata> event_metadata = 4;
+  map<int64, XStatMetadata> stat_metadata = 5;
+  repeated XStat stats = 6;
+}
+
+message XLine {
+  int64 id = 1;
+  int64 display_id = 10;
+  string name = 2;
+  string display_name = 11;
+  int64 timestamp_ns = 3;
+  int64 duration_ps = 9;
+  repeated XEvent events = 4;
+}
+
+message XEvent {
+  int64 metadata_id = 1;
+  oneof data {
+    int64 offset_ps = 2;
+    int64 num_occurrences = 5;
+  }
+  int64 duration_ps = 3;
+  repeated XStat stats = 4;
+}
+
+message XStat {
+  int64 metadata_id = 1;
+  oneof value {
+    double double_value = 2;
+    uint64 uint64_value = 3;
+    int64 int64_value = 4;
+    string str_value = 5;
+    bytes bytes_value = 6;
+    uint64 ref_value = 7;
+  }
+}
+
+message XEventMetadata {
+  int64 id = 1;
+  string name = 2;
+  string display_name = 4;
+  bytes metadata = 3;
+  repeated XStat stats = 5;
+  repeated int64 child_id = 6;
+}
+
+message XStatMetadata {
+  int64 id = 1;
+  string name = 2;
+  string description = 3;
+}
+"""
+
+_pb2 = None
+
+
+def _load_pb2():
+    global _pb2
+    if _pb2 is not None:
+        return _pb2
+    tmp = tempfile.mkdtemp(prefix="xplane_proto_")
+    src = os.path.join(tmp, "zt_xplane.proto")
+    with open(src, "w") as f:
+        f.write(_XPLANE_PROTO)
+    subprocess.run(
+        ["protoc", f"--proto_path={tmp}", f"--python_out={tmp}", src], check=True
+    )
+    out = os.path.join(tmp, "zt_xplane_pb2.py")
+    spec = importlib.util.spec_from_file_location("zt_xplane_pb2", out)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["zt_xplane_pb2"] = mod
+    spec.loader.exec_module(mod)
+    _pb2 = mod
+    return mod
+
+
+def latest_xspace(trace_dir: str):
+    """Parse the newest ``*.xplane.pb`` under a jax.profiler trace dir."""
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    pb2 = _load_pb2()
+    space = pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    return space
+
+
+def device_op_totals(space) -> Dict[str, Tuple[float, int]]:
+    """Aggregate event durations by op name over the device (TPU) planes.
+
+    Returns {op_name: (total_us, count)} from the XLA-op lines of every
+    non-host plane (host planes carry Python/runtime events, not device
+    compute).
+    """
+    totals: Dict[str, List[float]] = defaultdict(lambda: [0.0, 0])
+    for plane in space.planes:
+        name = plane.name.lower()
+        if "host" in name or "python" in name or "task" in name:
+            continue
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        for line in plane.lines:
+            lname = (line.display_name or line.name).lower()
+            # keep op-level lines; skip step/framework grouping lines
+            if "step" in lname and "xla" not in lname:
+                continue
+            for ev in line.events:
+                op = meta.get(ev.metadata_id, str(ev.metadata_id))
+                t = totals[op]
+                t[0] += ev.duration_ps / 1e6
+                t[1] += 1
+    return {k: (v[0], v[1]) for k, v in totals.items()}
+
+
+def top_ops(space, k: int = 15):
+    """Top-k device ops by total time: [(name, total_us, count, share)]."""
+    totals = device_op_totals(space)
+    grand = sum(t for t, _ in totals.values()) or 1.0
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])[:k]
+    return [(name, us, n, us / grand) for name, (us, n) in ranked]

@@ -121,9 +121,14 @@ class TestKafkaSource:
             assert storage.span_count == 3 * len(TRACE)  # retried through
             committed = {tp.partition: om.offset for tp, om in consumer.committed.items()}
             assert committed == {0: 3}
-            # the first commit must NOT have covered the rejected message 0
+            # Commits must be held until the rejected message 0 is retried
+            # and stored. The collector retries rejects before new polls,
+            # so the FIRST commit must cover exactly seq 0 (next-to-consume
+            # offset 1) — a first commit of 2 or 3 would mean the watermark
+            # advanced past the unstored message: the at-least-once
+            # regression this test exists to catch.
             first = {tp.partition: om.offset for tp, om in consumer.commit_calls[0].items()}
-            assert all(off >= 1 for off in first.values())
+            assert first == {0: 1}
             tc.close()
 
     def test_missing_client_raises_clearly(self):
@@ -150,6 +155,25 @@ class TestRabbitMQSource:
             assert ch.acks[-1] == (3, True)
             src.close()
             assert conn.closed
+
+    def test_commit_guards_tag_zero_and_reack(self):
+        """Watermark 0 (nothing contiguously stored yet) and repeated
+        watermarks must not reach basic_ack: AMQP reads tag 0 as "ack ALL
+        outstanding" (losing unstored deliveries) and re-acking a tag
+        closes the channel with PRECONDITION_FAILED."""
+        with fb.installed():
+            src = RabbitMQSource("amqp://guest@localhost", queue="zipkin")
+            ch = fb.FakeBlockingConnection.instances[-1].channel()
+            for b in (b"a", b"b"):
+                ch.feed(b)
+            src.poll(10, 0.1)
+            src.commit(0)  # out-of-order store path can produce watermark 0
+            assert ch.acks == []
+            src.commit(1)
+            src.commit(1)  # repeat of the same watermark: no re-ack
+            assert ch.acks == [(1, True)]
+            src.commit(2)
+            assert ch.acks == [(1, True), (2, True)]
 
     def test_end_to_end_with_transport_collector(self):
         storage = InMemoryStorage()

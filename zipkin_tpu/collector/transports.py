@@ -22,6 +22,7 @@ a tiny :class:`MessageSource` protocol with three in-repo sources:
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
@@ -35,6 +36,8 @@ from zipkin_tpu.collector.core import (
     InMemoryCollectorMetrics,
 )
 from zipkin_tpu.utils.component import CheckResult
+
+logger = logging.getLogger(__name__)
 
 # -- the transport seam ---------------------------------------------------
 
@@ -198,7 +201,15 @@ class KafkaSource(MessageSource):
                 "kafka-python is not installed; use ReplayFileSource or "
                 "QueueSource, or install kafka-python"
             ) from e
-        self._offset_meta = OffsetAndMetadata
+        # kafka-python >= 2.1 added a required leader_epoch field to the
+        # OffsetAndMetadata namedtuple; construct compatibly with both.
+        def _om(offset):
+            try:
+                return OffsetAndMetadata(offset, None, -1)
+            except TypeError:
+                return OffsetAndMetadata(offset, None)
+
+        self._offset_meta = _om
         self._consumer = KafkaConsumer(
             topic,
             bootstrap_servers=bootstrap_servers.split(","),
@@ -226,11 +237,16 @@ class KafkaSource(MessageSource):
             return
         per_tp: dict = {}
         for s in ready:
-            tp, koff = self._pending.pop(s)
+            tp, koff = self._pending[s]
             per_tp[tp] = max(per_tp.get(tp, -1), koff)
+        # commit BEFORE dropping from _pending: a failed commit (routine on
+        # rebalance) must leave the offsets re-committable by a later
+        # watermark, not silently forgotten.
         self._consumer.commit(
-            {tp: self._offset_meta(koff + 1, None) for tp, koff in per_tp.items()}
+            {tp: self._offset_meta(koff + 1) for tp, koff in per_tp.items()}
         )
+        for s in ready:
+            del self._pending[s]
 
     def close(self) -> None:
         self._consumer.close()
@@ -256,8 +272,9 @@ class RabbitMQSource(MessageSource):
         )
         self._channel = self._connection.channel()  # pragma: no cover
         self._queue = queue
+        self._committed = 0  # highest delivery tag already acked
 
-    def poll(self, max_messages, timeout):  # pragma: no cover
+    def poll(self, max_messages, timeout):
         out = []
         for _ in range(max_messages):
             method, _props, body = self._channel.basic_get(self._queue)
@@ -266,9 +283,16 @@ class RabbitMQSource(MessageSource):
             out.append(Message(body, method.delivery_tag))
         return out
 
-    def commit(self, offset) -> None:  # pragma: no cover
-        # delivery tags are cumulative: one multiple-ack covers <= offset
+    def commit(self, offset) -> None:
+        # Delivery tags are 1-based and multiple-acks are cumulative, so:
+        # tag 0 must never reach basic_ack (AMQP reads it as "ack ALL
+        # outstanding", which would ack unstored deliveries), and a
+        # repeated watermark must not re-ack an already-acked tag (the
+        # broker closes the channel with PRECONDITION_FAILED).
+        if offset <= self._committed or offset < 1:
+            return
         self._channel.basic_ack(offset, multiple=True)
+        self._committed = offset
 
     def close(self) -> None:  # pragma: no cover
         self._connection.close()
@@ -402,7 +426,17 @@ class TransportCollector(CollectorComponent):
             floor = min(self._outstanding) - 1 if self._outstanding else self._stored_high
             watermark = min(self._stored_high, floor)
             if watermark >= 0:
-                self.source.commit(watermark)  # after accept: at-least-once
+                try:
+                    self.source.commit(watermark)  # after accept: at-least-once
+                except Exception:
+                    # A failed commit (broker rebalance, transient I/O) must
+                    # not kill the worker: the spans ARE stored, and the
+                    # next stored message retries with >= this watermark.
+                    # Worst case is redelivery — the at-least-once contract.
+                    logger.warning(
+                        "%s commit(%d) failed; will retry on next store",
+                        self.transport, watermark, exc_info=True,
+                    )
 
     def _process(self, messages: List[Message]) -> List[Message]:
         """Store a batch; returns the unstored tail on storage failure

@@ -168,6 +168,13 @@ class ShardedAggregator:
         # (or, for the flush-on-read path, silently drop a batch by
         # overwriting the step's result). Reentrant: read paths nest.
         self.lock = threading.RLock()
+        # Host mirror of the per-shard digest pend_pos (identical on every
+        # shard: each advances by the same padded lane count per step).
+        # The host dispatches the flush program when the next batch would
+        # overflow — keeping the decision out of the step removed a
+        # lax.cond that copied both pending buffers every step (~45% of
+        # step device time, PROFILE_r02.md).
+        self._pend_lanes = 0
 
     # -- write path ------------------------------------------------------
 
@@ -178,9 +185,18 @@ class ShardedAggregator:
             fused = fuse_columns(cols)[None]
         else:
             fused = fuse_columns(route_columns(cols, self.n_shards))
+        lanes = int(fused.shape[-1])  # per-shard lane count (padded)
+        if lanes > self.config.digest_buffer:
+            raise ValueError(
+                f"batch of {lanes} lanes/shard exceeds digest_buffer "
+                f"({self.config.digest_buffer}); chunk before ingest"
+            )
         device_batch = jax.device_put(fused, self._sharding)
         with self.lock:
+            if self._pend_lanes + lanes > self.config.digest_buffer:
+                self._flush_now()
             self.state = self._step(self.state, device_batch)
+            self._pend_lanes += lanes
             c = self.host_counters
             c["spans"] += int(cols.valid.sum())
             c["spansWithDuration"] += int((cols.valid & cols.has_dur).sum())
@@ -213,9 +229,22 @@ class ShardedAggregator:
         from zipkin_tpu.ops import tdigest
 
         with self.lock:
-            self.state = self._flush(self.state)
+            self._flush_now()
             stacked = np.asarray(self.state.digest)  # [D, K, C, 2]
         return tdigest.merge_many(stacked)
+
+    def _flush_now(self) -> None:
+        """Compact the pending digest buffer and reset the host mirror —
+        the ONLY correct way to run the flush program (state swap and
+        mirror reset are one invariant). Callers hold the lock."""
+        self.state = self._flush(self.state)
+        self._pend_lanes = 0
+
+    def sync_pend_lanes(self) -> None:
+        """Re-derive the host pend mirror from device state (call after
+        replacing ``self.state`` wholesale, e.g. snapshot restore)."""
+        with self.lock:
+            self._pend_lanes = int(np.asarray(self.state.pend_pos).max())
 
     def state_arrays(self) -> list:
         """Consistent host copy of every state leaf (snapshot path)."""

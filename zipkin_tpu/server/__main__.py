@@ -1,10 +1,32 @@
-"""``python -m zipkin_tpu.server`` — boot from environment config."""
+"""``python -m zipkin_tpu.server`` — boot from environment config.
 
+Flags override the reference-named env vars (SURVEY.md §2.4 config row):
+``--port`` beats ``QUERY_PORT``, ``--storage`` beats ``STORAGE_TYPE``.
+"""
+
+import argparse
 import asyncio
 import logging
-
-from zipkin_tpu.server.app import run_server
+import os
 
 if __name__ == "__main__":
+    parser = argparse.ArgumentParser(prog="zipkin_tpu.server")
+    parser.add_argument(
+        "--port", type=int, default=None,
+        help="HTTP port (default: $QUERY_PORT or 9411)",
+    )
+    parser.add_argument(
+        "--storage", default=None,
+        help="storage backend: tpu|mem (default: $STORAGE_TYPE)",
+    )
+    args = parser.parse_args()
+    # env must be set before the app module builds its config
+    if args.port is not None:
+        os.environ["QUERY_PORT"] = str(args.port)
+    if args.storage is not None:
+        os.environ["STORAGE_TYPE"] = args.storage
+
+    from zipkin_tpu.server.app import run_server
+
     logging.basicConfig(level=logging.INFO)
     asyncio.run(run_server())

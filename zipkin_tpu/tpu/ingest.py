@@ -47,9 +47,14 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     # --- latency sketches per (service, spanName) key -------------------
     has_dur = valid & batch.has_dur
     new_hist = histogram.update(state.hist, batch.key, batch.dur, has_dur)
-    # t-digest: append to the pending buffer; compact only when it would
-    # overflow (amortizes the K*C-point sort across ~P/n batches).
-    new_digest, pend_key, pend_val, pend_pos = _digest_buffered_update(
+    # t-digest: append to the pending buffer; compaction is a SEPARATE
+    # program the host dispatches when the buffer would overflow (it
+    # tracks pend_pos exactly — every shard advances by the same padded
+    # lane count). Round 1 embedded the decision as a lax.cond here; the
+    # cond forced full copies of both pending buffers through the
+    # conditional every step (~45% of step device time in the r2 profile
+    # capture, PROFILE_r02.md) even when no flush ran.
+    pend_key, pend_val, pend_pos = _digest_append(
         config, state, batch.key, batch.dur.astype(jnp.float32), has_dur
     )
 
@@ -71,7 +76,6 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     new_state = state._replace(
         hll=new_hll,
         hist=new_hist,
-        digest=new_digest,
         pend_key=pend_key,
         pend_val=pend_val,
         pend_pos=pend_pos,
@@ -117,33 +121,17 @@ def _flush_pending_digest(
     return tdigest.row_merge(digest, partial)
 
 
-def _digest_buffered_update(
-    config: AggConfig, state: AggState, key, val, has_dur
-):
-    n = key.shape[0]
-    p = config.digest_buffer
+def _digest_append(config: AggConfig, state: AggState, key, val, has_dur):
+    """Append the batch's (key, value) points to the pending ring.
+
+    PRECONDITION (host-enforced, see ShardedAggregator.ingest): pend_pos +
+    n <= digest_buffer — dynamic_update_slice CLAMPS out-of-range starts,
+    which would silently overwrite the buffer tail."""
     batch_key = jnp.where(has_dur, jnp.clip(key, 0, config.max_keys - 1), -1)
-
-    def with_flush():
-        d = _flush_pending_digest(config, state.digest, state.pend_key, state.pend_val)
-        # derive the resets from state so they stay shard-varying under
-        # shard_map (fresh constants would not match the other cond branch)
-        return (
-            d,
-            jnp.full_like(state.pend_key, -1),
-            jnp.zeros_like(state.pend_val),
-            jnp.zeros_like(state.pend_pos),
-        )
-
-    def without_flush():
-        return state.digest, state.pend_key, state.pend_val, state.pend_pos
-
-    digest, pk, pv, pos = jax.lax.cond(
-        state.pend_pos + n > p, with_flush, without_flush
-    )
-    pk = jax.lax.dynamic_update_slice(pk, batch_key, (pos,))
-    pv = jax.lax.dynamic_update_slice(pv, val, (pos,))
-    return digest, pk, pv, pos + n
+    pos = state.pend_pos
+    pk = jax.lax.dynamic_update_slice(state.pend_key, batch_key, (pos,))
+    pv = jax.lax.dynamic_update_slice(state.pend_val, val, (pos,))
+    return pk, pv, pos + key.shape[0]
 
 
 def flush_digest(config: AggConfig, state: AggState) -> AggState:

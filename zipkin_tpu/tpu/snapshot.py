@@ -104,6 +104,7 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
         store.agg.state = jax.device_put(
             type(template)(*leaves), store.agg._sharding
         )
+        store.agg.sync_pend_lanes()
 
     saved_counters = meta.get("counters", {})
     for key in store.agg.host_counters:
