@@ -50,8 +50,10 @@ def test_concurrent_ingest_and_reads():
 
 def test_oversized_batch_is_chunked():
     store = TpuStorage(config=CFG, pad_to_multiple=256)
-    assert store.max_batch == CFG.digest_buffer
-    spans = lots_of_spans(CFG.digest_buffer + 500, seed=18, services=4, span_names=4)
+    # bounded by BOTH the digest pending buffer and the rollup segment
+    # (a batch may never out-write the pre-eviction link rollup)
+    assert store.max_batch == min(CFG.digest_buffer, CFG.rollup_segment)
+    spans = lots_of_spans(store.max_batch + 500, seed=18, services=4, span_names=4)
     store.accept(spans).execute()
     assert store.ingest_counters()["spans"] == len(spans)
     assert store.ingest_counters()["batches"] == 2

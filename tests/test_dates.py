@@ -1,0 +1,45 @@
+"""DateUtil-analog semantics (zipkin2/internal/DateUtil.java parity)."""
+
+from zipkin_tpu.internal.dates import (
+    DAY_MS,
+    epoch_days,
+    epoch_minutes,
+    midnight_utc,
+)
+
+
+def test_midnight_utc_floors():
+    # 2020-01-02T13:45:00Z
+    ts = 1577972700000
+    m = midnight_utc(ts)
+    assert m % DAY_MS == 0
+    assert m <= ts < m + DAY_MS
+
+
+def test_midnight_utc_on_boundary_is_identity():
+    m = 1577923200000  # 2020-01-02T00:00:00Z
+    assert midnight_utc(m) == m
+
+
+def test_epoch_days_enumerates_inclusive():
+    end = 1577972700000  # Jan 2
+    days = epoch_days(end, 2 * DAY_MS)
+    assert len(days) == 3  # Dec 31, Jan 1, Jan 2
+    assert all(d % DAY_MS == 0 for d in days)
+    assert days[-1] == midnight_utc(end)
+    assert days[0] == midnight_utc(end - 2 * DAY_MS)
+
+
+def test_epoch_days_zero_lookback_is_one_day():
+    end = 1577972700000
+    assert epoch_days(end, 0) == [midnight_utc(end)]
+
+
+def test_epoch_days_clamps_negative_start():
+    days = epoch_days(DAY_MS // 2, 10 * DAY_MS)
+    assert days[0] == 0
+
+
+def test_epoch_minutes_clamps():
+    assert epoch_minutes(-5) == 0
+    assert epoch_minutes(120_000) == 2

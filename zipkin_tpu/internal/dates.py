@@ -1,0 +1,38 @@
+"""Date/time bucketing helpers — the ``zipkin2/internal/DateUtil.java``
+analog (SURVEY.md §2.1 internal-utils row).
+
+The reference buckets retention by UTC day (daily ES indices
+``zipkin*span-YYYY-MM-dd``, daily cassandra ``dependency`` rows keyed by
+midnight); the TPU tier buckets by configurable minutes
+(AggConfig.bucket_minutes / hist_slice_minutes). Both conventions meet
+here: millisecond query parameters in, bucket indices out.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+DAY_MS = 86_400_000
+MINUTE_MS = 60_000
+
+
+def midnight_utc(epoch_ms: int) -> int:
+    """Midnight UTC (ms) of the day containing ``epoch_ms`` — the
+    reference's ``DateUtil.midnightUTC`` (floor, also for negatives)."""
+    return (epoch_ms // DAY_MS) * DAY_MS
+
+
+def epoch_days(end_ts_ms: int, lookback_ms: int) -> List[int]:
+    """Midnights (ms) of every UTC day touched by [endTs - lookback,
+    endTs] — the reference's ``DateUtil.epochDays``, which storage
+    backends use to enumerate daily rollup rows to merge."""
+    first = midnight_utc(max(end_ts_ms - lookback_ms, 0))
+    last = midnight_utc(end_ts_ms)
+    return list(range(first, last + DAY_MS, DAY_MS))
+
+
+def epoch_minutes(epoch_ms: int) -> int:
+    """Epoch minutes — the device tier's time unit (ring ``ts_min``,
+    rollup/slice bucket inputs); clamped at 0. This is the single
+    ms-to-minute conversion point for query windows (TpuStorage)."""
+    return max(int(epoch_ms) // MINUTE_MS, 0)

@@ -75,42 +75,18 @@ def _run_max(values: jnp.ndarray, key_lanes: Sequence[jnp.ndarray]) -> jnp.ndarr
     return seg[run_id]
 
 
-def _equal_join(
-    table_keys: Sequence[jnp.ndarray],
-    table_valid: jnp.ndarray,
-    query_keys: Sequence[jnp.ndarray],
-    query_valid: jnp.ndarray,
-) -> jnp.ndarray:
-    """For each query lane, the index of *a* valid table lane whose composite
-    key (u32 lanes) equals the query's, else -1. One lexsort of the union.
-    """
-    n = table_valid.shape[0]
-    lanes = []
-    for t, q in zip(table_keys, query_keys):
-        lanes.append(jnp.concatenate([t.astype(jnp.uint32), q.astype(jnp.uint32)]))
-    # invalid lanes get a key of all-ones so they cluster harmlessly at the end
-    anyvalid = jnp.concatenate([table_valid, query_valid])
-    lanes = [jnp.where(anyvalid, l, jnp.uint32(0xFFFFFFFF)) for l in lanes]
-
-    value = jnp.concatenate(
-        [
-            jnp.where(table_valid, jnp.arange(n, dtype=jnp.int32), -1),
-            jnp.full((n,), -1, jnp.int32),
-        ]
-    )
-    # lexsort: last key is primary; order within equal keys is irrelevant
-    # because _run_max scans the whole run.
-    order = jnp.lexsort(tuple(lanes))
-    matched = _run_max(value[order], [l[order] for l in lanes])
-    # scatter back to original positions
-    unsorted = jnp.zeros(2 * n, jnp.int32).at[order].set(matched)
-    result = unsorted[n:]
-    return jnp.where(query_valid, result, -1)
-
-
 def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Tree edges from id joins: returns (parent_row [n] with -1 for roots,
-    has_child [n] bool)."""
+    has_child [n] bool).
+
+    All three id joins (shared half -> client half, parent-id -> shared
+    rendition, parent-id -> non-shared) ride ONE lexsort of a 2n-lane
+    union — table lanes keyed by own (trace, span-id), query lanes keyed
+    by (trace, parent-id) — with per-run maxima taken separately over
+    shared and non-shared table indices. The r2 profile capture showed the
+    original three independent sort-merge joins dominating the rollup
+    program (PROFILE_r02.md); one sort does the work of three.
+    """
     n = x.valid.shape[0]
     trace = (x.trace_h, x.tl0, x.tl1)
     has_parent = ((x.p0 | x.p1) != 0) & x.valid
@@ -119,14 +95,37 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
     own_key = trace + (x.s0, x.s1)
     parent_key = trace + (x.p0, x.p1)
+    q_valid = nonshared & has_parent
 
-    # shared server half -> its client half (same id, non-shared)
-    j_shared = _equal_join(own_key, nonshared, own_key, sharedv)
-    # normal span -> parent id, preferring the shared rendition
-    j_to_shared = _equal_join(own_key, sharedv, parent_key, nonshared & has_parent)
-    j_to_normal = _equal_join(own_key, nonshared, parent_key, nonshared & has_parent)
+    anyvalid = jnp.concatenate([x.valid, q_valid])
+    lanes = [
+        jnp.where(
+            anyvalid,
+            jnp.concatenate([t.astype(jnp.uint32), q.astype(jnp.uint32)]),
+            jnp.uint32(0xFFFFFFFF),
+        )
+        for t, q in zip(own_key, parent_key)
+    ]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    neg = jnp.full((n,), -1, jnp.int32)
+    val_sh = jnp.concatenate([jnp.where(sharedv, idx, -1), neg])
+    val_ns = jnp.concatenate([jnp.where(nonshared, idx, -1), neg])
+
+    order = jnp.lexsort(tuple(lanes))
+    sorted_lanes = [l[order] for l in lanes]
+    rm_sh = _run_max(val_sh[order], sorted_lanes)
+    rm_ns = _run_max(val_ns[order], sorted_lanes)
+    inv = jnp.zeros(2 * n, jnp.int32)
+    un_sh = inv.at[order].set(rm_sh)
+    un_ns = inv.at[order].set(rm_ns)
+
+    # table half: run-max over lanes sharing MY own id
+    # query half: run-max over lanes whose own id equals MY parent id
+    j_shared = jnp.where(sharedv, un_ns[:n], -1)
+    j_to_shared = jnp.where(q_valid, un_sh[n:], -1)
+    j_to_normal = jnp.where(q_valid, un_ns[n:], -1)
     # a span must not become its own parent (self-parent == root)
-    self_idx = jnp.arange(n, dtype=jnp.int32)
+    self_idx = idx
     j_to_normal = jnp.where(j_to_normal == self_idx, -1, j_to_normal)
 
     parent = jnp.where(
@@ -168,16 +167,19 @@ def nearest_rpc_ancestor(
     return anc
 
 
-def link_window(
-    x: LinkInput, num_services: int
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Dependency links over one span window.
+def link_edges(x: LinkInput, emit: jnp.ndarray = None):
+    """Per-lane link-rule evaluation shared by the flat and bucketed
+    scatters: returns (par_svc, child_svc, main_ok, main_err, anc_svc,
+    local, back_ok).
 
-    Returns (calls, errors) — ``[num_services, num_services]`` uint32
-    matrices indexed by interned service id (0 = unknown; row/col 0 is
-    never emitted). Merge across shards/windows by addition (psum).
+    ``emit`` restricts which spans may EMIT edges; parent/ancestor joins
+    always run over every ``x.valid`` lane, so a windowed query still
+    resolves tree context from outside the window — matching the
+    reference's whole-trace linking (InMemory getDependencies links full
+    traces whose span timestamps intersect the window, SURVEY.md §3.5).
     """
-    n = x.valid.shape[0]
+    if emit is None:
+        emit = x.valid
     parent, has_child = resolve_parents(x)
     anc = nearest_rpc_ancestor(parent, jnp.where(x.valid, x.kind, 0))
     anc_svc = jnp.where(anc >= 0, x.svc[jnp.where(anc >= 0, anc, 0)], 0)
@@ -186,7 +188,7 @@ def link_window(
     kind = x.kind
 
     # rule 1: client span with children defers to its server half
-    live = x.valid & ~((kind == KIND_CLIENT) & has_child)
+    live = emit & x.valid & ~((kind == KIND_CLIENT) & has_child)
     # rule 2: kindless spans with both sides known act like clients
     keff = jnp.where(
         (kind == KIND_NONE) & (local > 0) & (remote > 0), KIND_CLIENT, kind
@@ -221,7 +223,21 @@ def link_window(
         & (anc_svc > 0)
         & (anc_svc != local)
     )
+    return par_svc, child_svc, main_ok, main_err, anc_svc, local, back_ok
 
+
+def link_window(
+    x: LinkInput, num_services: int, emit: jnp.ndarray = None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dependency links over one span window.
+
+    Returns (calls, errors) — ``[num_services, num_services]`` uint32
+    matrices indexed by interned service id (0 = unknown; row/col 0 is
+    never emitted). Merge across shards/windows by addition (psum).
+    """
+    par_svc, child_svc, main_ok, main_err, anc_svc, local, back_ok = link_edges(
+        x, emit
+    )
     s = num_services
     calls = jnp.zeros((s, s), jnp.uint32)
     errors = jnp.zeros((s, s), jnp.uint32)
@@ -232,4 +248,32 @@ def link_window(
     bc = jnp.clip(anc_svc, 0, s - 1)
     lc = jnp.clip(local, 0, s - 1)
     calls = calls.at[bc, lc].add(back_ok.astype(jnp.uint32))
+    return calls, errors
+
+
+def link_window_bucketed(
+    x: LinkInput,
+    num_services: int,
+    slot: jnp.ndarray,
+    num_slots: int,
+    emit: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Same rules, but each emitting span scatters its edges into the
+    time-bucket ``slot[i]`` of its OWN timestamp — the device form of the
+    reference's per-day dependency rollup (links attributed to the day of
+    the child span, SURVEY.md §2.3 cassandra ``dependency`` table)."""
+    par_svc, child_svc, main_ok, main_err, anc_svc, local, back_ok = link_edges(
+        x, emit
+    )
+    s = num_services
+    d = jnp.clip(slot.astype(jnp.int32), 0, num_slots - 1)
+    calls = jnp.zeros((num_slots, s, s), jnp.uint32)
+    errors = jnp.zeros((num_slots, s, s), jnp.uint32)
+    pc = jnp.clip(par_svc, 0, s - 1)
+    cc = jnp.clip(child_svc, 0, s - 1)
+    calls = calls.at[d, pc, cc].add(main_ok.astype(jnp.uint32))
+    errors = errors.at[d, pc, cc].add(main_err.astype(jnp.uint32))
+    bc = jnp.clip(anc_svc, 0, s - 1)
+    lc = jnp.clip(local, 0, s - 1)
+    calls = calls.at[d, bc, lc].add(back_ok.astype(jnp.uint32))
     return calls, errors

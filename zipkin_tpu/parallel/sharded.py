@@ -136,7 +136,32 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         ),
         donate_argnums=(0,),
     )
-    return init, step, links, merge, flush, sharding
+
+    def spmd_rollup(state: AggState) -> AggState:
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        out = ing.rollup_step(config, s)
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    rollup = jax.jit(
+        shard_map(
+            spmd_rollup, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS)
+        ),
+        donate_argnums=(0,),
+    )
+
+    def spmd_whist(state: AggState, ts_lo, ts_hi):
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        return jax.lax.psum(
+            ing.windowed_hist(config, s, ts_lo, ts_hi), SHARD_AXIS
+        )
+
+    whist = jax.jit(
+        shard_map(
+            spmd_whist, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(),
+        )
+    )
+    return init, step, links, merge, flush, rollup, whist, sharding
 
 
 class ShardedAggregator:
@@ -150,9 +175,10 @@ class ShardedAggregator:
         self.config = config
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape))
-        init, self._step, self._links, self._merge, self._flush, self._sharding = (
-            _compiled_programs(config, mesh)
-        )
+        (
+            init, self._step, self._links, self._merge, self._flush,
+            self._rollup, self._whist, self._sharding,
+        ) = _compiled_programs(config, mesh)
         self.state: AggState = init()
         # Exact host-side counters: the device counters are u32 and wrap
         # after ~4.3B spans (~72 min at the north-star rate); these are the
@@ -175,6 +201,12 @@ class ShardedAggregator:
         # lax.cond that copied both pending buffers every step (~45% of
         # step device time, PROFILE_r02.md).
         self._pend_lanes = 0
+        # Lanes written since the last link rollup. When the next batch
+        # would push this past rollup_segment (= R/2), the rollup program
+        # runs first: it links + invalidates the half-ring ahead of the
+        # cursor, so spans are never overwritten before their links are
+        # folded into the time-bucketed rollup matrices.
+        self._lanes_since_rollup = 0
 
     # -- write path ------------------------------------------------------
 
@@ -186,17 +218,21 @@ class ShardedAggregator:
         else:
             fused = fuse_columns(route_columns(cols, self.n_shards))
         lanes = int(fused.shape[-1])  # per-shard lane count (padded)
-        if lanes > self.config.digest_buffer:
+        if lanes > min(self.config.digest_buffer, self.config.rollup_segment):
             raise ValueError(
                 f"batch of {lanes} lanes/shard exceeds digest_buffer "
-                f"({self.config.digest_buffer}); chunk before ingest"
+                f"({self.config.digest_buffer}) or rollup_segment "
+                f"({self.config.rollup_segment}); chunk before ingest"
             )
         device_batch = jax.device_put(fused, self._sharding)
         with self.lock:
             if self._pend_lanes + lanes > self.config.digest_buffer:
                 self._flush_now()
+            if self._lanes_since_rollup + lanes > self.config.rollup_segment:
+                self.rollup_now()
             self.state = self._step(self.state, device_batch)
             self._pend_lanes += lanes
+            self._lanes_since_rollup += lanes
             c = self.host_counters
             c["spans"] += int(cols.valid.sum())
             c["spansWithDuration"] += int((cols.valid & cols.has_dur).sum())
@@ -240,11 +276,30 @@ class ShardedAggregator:
         self.state = self._flush(self.state)
         self._pend_lanes = 0
 
+    def rollup_now(self) -> None:
+        """Run the link-rollup program (rollup_step) and reset the
+        write-distance tracker. Public for tests and shutdown paths."""
+        with self.lock:
+            self.state = self._rollup(self.state)
+            self._lanes_since_rollup = 0
+
+    def windowed_histograms(self, ts_lo_min: int, ts_hi_min: int) -> np.ndarray:
+        """[K, BUCKETS] histogram over the window, merged across shards
+        (empty rows where the window predates the slice retention)."""
+        with self.lock:
+            out = self._whist(
+                self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
+            )
+            return np.asarray(out)
+
     def sync_pend_lanes(self) -> None:
         """Re-derive the host pend mirror from device state (call after
         replacing ``self.state`` wholesale, e.g. snapshot restore)."""
         with self.lock:
             self._pend_lanes = int(np.asarray(self.state.pend_pos).max())
+            # write distance since the last rollup is not recorded in
+            # state; assume the worst so the next batch rolls up first
+            self._lanes_since_rollup = self.config.rollup_segment
 
     def state_arrays(self) -> list:
         """Consistent host copy of every state leaf (snapshot path)."""

@@ -63,12 +63,14 @@ def build_storage(config: ServerConfig) -> StorageComponent:
         return InMemoryStorage(max_span_count=config.mem_max_spans, **common)
     if config.storage_type == "tpu":
         from zipkin_tpu.storage.tpu import TpuStorage
+        from zipkin_tpu.tpu.state import AggConfig
 
         return TpuStorage(
             max_span_count=config.mem_max_spans,
             batch_size=config.tpu_batch_size,
             num_devices=config.tpu_devices,
             checkpoint_dir=config.tpu_checkpoint_dir,
+            config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
             **common,
         )
     raise ValueError(f"unknown STORAGE_TYPE: {config.storage_type}")
@@ -387,6 +389,12 @@ class ZipkinServer:
             qs = [float(x) for x in raw_q.split(",") if x]
             if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
                 raise ValueError(f"q out of range: {raw_q!r}")
+            # optional endTs/lookback (ms, the query-API convention) route
+            # to the time-sliced histograms — windowed percentiles
+            end_ts = request.query.get("endTs")
+            lookback = request.query.get("lookback")
+            end_ts = int(end_ts) if end_ts is not None else None
+            lookback = int(lookback) if lookback is not None else None
         except ValueError as e:
             return web.Response(status=400, text=str(e))
         rows = await asyncio.to_thread(
@@ -395,6 +403,8 @@ class ZipkinServer:
             request.query.get("serviceName"),
             request.query.get("spanName"),
             request.query.get("sketch", "digest") == "digest",
+            end_ts,
+            lookback,
         )
         return web.json_response(rows)
 

@@ -66,6 +66,9 @@ class ServerConfig:
     tpu_batch_size: int = 8192
     tpu_fast_ingest: bool = False  # line-rate JSON->device path, no archive
     tpu_checkpoint_dir: Optional[str] = None
+    # device state shape (see zipkin_tpu.tpu.state.AggConfig); None =
+    # AggConfig's default for that field
+    tpu_agg: dict = dataclasses.field(default_factory=dict)
 
     @staticmethod
     def from_env() -> "ServerConfig":
@@ -93,4 +96,22 @@ class ServerConfig:
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_fast_ingest=_env_bool("TPU_FAST_INGEST", False),
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
+            tpu_agg=_env_agg(),
         )
+
+
+# AggConfig fields sizable from the environment (TPU_MAX_SERVICES=256 etc.)
+_AGG_ENV_FIELDS = (
+    "max_services", "max_keys", "hll_precision", "digest_centroids",
+    "digest_buffer", "ring_capacity", "link_buckets", "bucket_minutes",
+    "hist_slices", "hist_slice_minutes",
+)
+
+
+def _env_agg() -> dict:
+    out = {}
+    for field in _AGG_ENV_FIELDS:
+        raw = os.environ.get("TPU_" + field.upper())
+        if raw:
+            out[field] = int(raw)
+    return out

@@ -47,6 +47,7 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     # --- latency sketches per (service, spanName) key -------------------
     has_dur = valid & batch.has_dur
     new_hist = histogram.update(state.hist, batch.key, batch.dur, has_dur)
+    new_hist_t, new_hist_t_epoch = _hist_slice_update(config, state, batch, has_dur)
     # t-digest: append to the pending buffer; compaction is a SEPARATE
     # program the host dispatches when the buffer would overflow (it
     # tracks pend_pos exactly — every shard advances by the same padded
@@ -76,6 +77,8 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     new_state = state._replace(
         hll=new_hll,
         hist=new_hist,
+        hist_t=new_hist_t,
+        hist_t_epoch=new_hist_t_epoch,
         pend_key=pend_key,
         pend_val=pend_val,
         pend_pos=pend_pos,
@@ -93,6 +96,7 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
         r_err=put(state.r_err, batch.err),
         r_ts_min=put(state.r_ts_min, batch.ts_min),
         r_valid=put(state.r_valid, valid),
+        r_rolled=put(state.r_rolled, jnp.zeros((n,), bool)),
         ring_pos=(state.ring_pos + live) % config.ring_capacity,
         counters=state.counters.at[CTR_SPANS].add(live.astype(jnp.uint32))
         .at[CTR_WITH_DURATION].add(jnp.sum(has_dur).astype(jnp.uint32))
@@ -100,6 +104,52 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
         .at[CTR_BATCHES].add(1),
     )
     return new_state
+
+
+def _recycle_slots(num_slots, stored_epoch, slot, ep, active):
+    """Epoch-ring slot management shared by the histogram slices and link
+    rollups: a slot is zeroed ("wiped") when a batch brings it a NEWER
+    absolute epoch; items older than what the slot then holds are dropped
+    from the windowed view — the late-arrival semantics of the
+    reference's daily indices, where a late span lands in an old daily
+    index that queries no longer scan (SURVEY.md §2.3).
+
+    Returns (new_epoch [D], wipe [D] bool, keep [n] bool).
+    """
+    slot_ep = jnp.full((num_slots,), -1, jnp.int32).at[slot].max(
+        jnp.where(active, ep, -1)
+    )
+    new_epoch = jnp.maximum(stored_epoch, slot_ep)
+    wipe = slot_ep > stored_epoch
+    keep = active & (ep == new_epoch[slot])
+    return new_epoch, wipe, keep
+
+
+def _slots_in_window(epoch, lo_unit, hi_unit):
+    """[D] bool: which epoch-ring slots hold a bucket intersecting the
+    window (whole-bucket granularity, as when the reference merges the
+    daily rollup rows of a lookback — SURVEY.md §3.5)."""
+    return (epoch >= 0) & (epoch >= lo_unit) & (epoch <= hi_unit)
+
+
+def _masked_slot_sum(sel, arr):
+    """Sum [D, ...] over the slots selected by ``sel`` (dtype-preserving)."""
+    return jnp.sum(jnp.where(sel[:, None, None], arr, 0), axis=0).astype(arr.dtype)
+
+
+def _hist_slice_update(config: AggConfig, state: AggState, batch, has_dur):
+    """Fold durations into the time-sliced histograms (slice = epoch % T,
+    recycled per :func:`_recycle_slots`; the all-time ``hist`` keeps every
+    count regardless)."""
+    t = config.hist_slices
+    ep = (batch.ts_min // jnp.uint32(config.hist_slice_minutes)).astype(jnp.int32)
+    sl = ep % t
+    new_epoch, wipe, ok = _recycle_slots(t, state.hist_t_epoch, sl, ep, has_dur)
+    hist_t = jnp.where(wipe[:, None, None], jnp.uint32(0), state.hist_t)
+    b = histogram.bucket_of(batch.dur)
+    k = jnp.clip(batch.key.astype(jnp.int32), 0, config.max_keys - 1)
+    hist_t = hist_t.at[sl, k, b].add(ok.astype(jnp.uint32))
+    return hist_t, new_epoch
 
 
 def _flush_pending_digest(
@@ -146,32 +196,101 @@ def flush_digest(config: AggConfig, state: AggState) -> AggState:
     )
 
 
-def ring_link_input(state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray) -> linker.LinkInput:
-    """View the retention ring as a link window restricted to [ts_lo, ts_hi]
-    epoch minutes (inclusive)."""
-    in_window = (state.r_ts_min >= ts_lo) & (state.r_ts_min <= ts_hi)
+def ring_link_input(state: AggState) -> linker.LinkInput:
+    """View the retention ring as a link window (all valid lanes; use the
+    ``emit`` mask of link_window/link_edges for time filtering so parent
+    joins keep full-ring context)."""
     return linker.LinkInput(
         trace_h=state.r_trace_h, tl0=state.r_tl0, tl1=state.r_tl1,
         s0=state.r_s0, s1=state.r_s1, p0=state.r_p0, p1=state.r_p1,
         shared=state.r_shared, kind=state.r_kind,
         svc=state.r_svc, rsvc=state.r_rsvc, err=state.r_err,
-        valid=state.r_valid & in_window,
+        valid=state.r_valid,
+    )
+
+
+def rollup_step(config: AggConfig, state: AggState) -> AggState:
+    """Link the half-ring the cursor will overwrite next and fold the
+    edges into per-time-bucket rollup matrices, then invalidate those
+    ring lanes.
+
+    This is the reference's zipkin-dependencies batch job run on-device
+    ahead of eviction (SURVEY.md §3.5): links are attributed to the
+    bucket of the child span's timestamp (like the daily ``dependency``
+    rows), parents resolve against the FULL ring (whole-trace context),
+    and a bucket slot is recycled — zeroed — when a newer epoch folds in.
+    The host dispatches this before writes since the last rollup exceed
+    ``config.rollup_segment`` (see ShardedAggregator.ingest), so no valid
+    span is ever overwritten without its links being preserved.
+    """
+    r = config.ring_capacity
+    lane = jnp.arange(r, dtype=jnp.int32)
+    offset = (lane - state.ring_pos) % r
+    to_roll = state.r_valid & ~state.r_rolled & (offset < config.rollup_segment)
+
+    bm = jnp.uint32(config.bucket_minutes)
+    bucket_abs = (state.r_ts_min // bm).astype(jnp.int32)
+    d = config.link_buckets
+    slot = bucket_abs % d
+    new_epoch, wipe, emit = _recycle_slots(
+        d, state.rollup_epoch, slot, bucket_abs, to_roll
+    )
+
+    calls_d, errs_d = linker.link_window_bucketed(
+        ring_link_input(state), config.max_services, slot, d, emit
+    )
+    rollup_calls = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_calls)
+    rollup_errs = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_errs)
+    return state._replace(
+        rollup_calls=rollup_calls + calls_d,
+        rollup_errs=rollup_errs + errs_d,
+        rollup_epoch=new_epoch,
+        # rolled lanes stop emitting but stay join-visible (r_valid keeps
+        # them in the parent table until the cursor overwrites them) — so
+        # a live child written shortly after its parent rolled still
+        # resolves full tree context at query or rollup time
+        r_rolled=state.r_rolled | to_roll,
     )
 
 
 def dependency_links(
     config: AggConfig, state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(calls, errors) [S, S] u32 over the ring window — the on-device
-    replacement for the zipkin-dependencies batch job (SURVEY.md §3.5)."""
-    return linker.link_window(
-        ring_link_input(state, ts_lo, ts_hi), config.max_services
+    """(calls, errors) [S, S] u32 over [ts_lo, ts_hi] epoch minutes —
+    live-ring links merged with the rolled-up buckets in the window (the
+    reference's "merge days: sum callCount/errorCount", SURVEY.md §3.5).
+    """
+    in_window = (state.r_ts_min >= ts_lo) & (state.r_ts_min <= ts_hi)
+    calls, errors = linker.link_window(
+        ring_link_input(state), config.max_services,
+        emit=state.r_valid & ~state.r_rolled & in_window,
     )
+    bm = config.bucket_minutes
+    lo_b = (ts_lo // jnp.uint32(bm)).astype(jnp.int32)
+    hi_b = (ts_hi // jnp.uint32(bm)).astype(jnp.int32)
+    sel = _slots_in_window(state.rollup_epoch, lo_b, hi_b)
+    calls = calls + _masked_slot_sum(sel, state.rollup_calls)
+    errors = errors + _masked_slot_sum(sel, state.rollup_errs)
+    return calls, errors
 
 
 def key_quantiles(state: AggState, qs: jnp.ndarray) -> jnp.ndarray:
     """[keys, Q] latency quantiles from the histograms."""
     return histogram.quantile(state.hist, qs)
+
+
+def windowed_hist(
+    config: AggConfig, state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray
+) -> jnp.ndarray:
+    """[keys, BUCKETS] histogram summed over the time slices intersecting
+    [ts_lo, ts_hi] epoch minutes — the windowed-percentile source.
+    Coverage is the most recent T*slice_minutes; older windows return
+    empty rows (callers fall back to the all-time ``hist``)."""
+    sm = config.hist_slice_minutes
+    lo_e = (ts_lo // jnp.uint32(sm)).astype(jnp.int32)
+    hi_e = (ts_hi // jnp.uint32(sm)).astype(jnp.int32)
+    sel = _slots_in_window(state.hist_t_epoch, lo_e, hi_e)
+    return _masked_slot_sum(sel, state.hist_t)
 
 
 def key_quantiles_digest(state: AggState, qs: jnp.ndarray) -> jnp.ndarray:

@@ -15,6 +15,7 @@ support offsets (replay files, Kafka) can resume from
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
@@ -49,14 +50,7 @@ def save(store: "TpuStorage", directory: str) -> str:
         "version": 1,
         "saved_at": time.time(),
         "n_shards": store.agg.n_shards,
-        "config": {
-            "max_services": store.config.max_services,
-            "max_keys": store.config.max_keys,
-            "hll_precision": store.config.hll_precision,
-            "digest_centroids": store.config.digest_centroids,
-            "digest_buffer": store.config.digest_buffer,
-            "ring_capacity": store.config.ring_capacity,
-        },
+        "config": dataclasses.asdict(store.config),
         "counters": store.ingest_counters(),
         "services": store.vocab.services._names,
         "span_names": store.vocab.span_names._names,
@@ -77,14 +71,7 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
         return False
     with open(meta_path) as f:
         meta = json.load(f)
-    want = {
-        "max_services": store.config.max_services,
-        "max_keys": store.config.max_keys,
-        "hll_precision": store.config.hll_precision,
-        "digest_centroids": store.config.digest_centroids,
-        "digest_buffer": store.config.digest_buffer,
-        "ring_capacity": store.config.ring_capacity,
-    }
+    want = dataclasses.asdict(store.config)
     if meta.get("config") != want or meta.get("n_shards") != store.agg.n_shards:
         logger.warning(
             "snapshot at %s is incompatible (config/shards changed); ignoring",

@@ -8,11 +8,22 @@ SURVEY.md §2.3), the TPU tier keeps **fixed-shape aggregate state in HBM**
 - ``hll``      — [services+1, m] u8: distinct-trace registers, row per
                  service, last row global.
 - ``hist``     — [keys, BUCKETS] u32: per-(service, spanName) latency
-                 histograms (psum-mergeable).
+                 histograms (psum-mergeable), all-time.
+- ``hist_t``   — [T, keys, BUCKETS] u32: time-sliced histograms (slice =
+                 epoch-hour % T) so percentile queries can be WINDOWED —
+                 the sketch analog of the reference's daily ES indices.
 - ``digest``   — [keys, C, 2] f32: per-key t-digests for tight tails.
 - ring columns — a circular columnar span window (capacity R) feeding the
-                 windowed dependency-link job; the HBM analog of the
-                 reference's time-bucketed retention (daily ES indices).
+                 windowed dependency-link job.
+- rollup       — [D, S, S] per-time-bucket dependency-link matrices: when
+                 ring spans are about to be overwritten, a rollup program
+                 links them and folds the edges into the bucket of the
+                 child span's timestamp. This is the exact analog of the
+                 reference's PRE-AGGREGATED daily ``dependency`` rows
+                 (cassandra schema / zipkin-dependencies job, SURVEY.md
+                 §2.3, §3.5) — links survive ring eviction, and
+                 ``get_dependencies(endTs, lookback)`` merges live-ring
+                 links with the buckets in the window.
 - ``counters`` — ingest telemetry (CollectorMetrics taxonomy, §2.2).
 
 The whole state is one NamedTuple pytree of arrays → trivially donatable,
@@ -48,7 +59,16 @@ class AggConfig:
     # per-span compaction cost vs 64k (the sort is dominated by the
     # K*C existing-centroid lanes, so a bigger buffer is nearly free).
     digest_buffer: int = 1 << 17
-    ring_capacity: int = 1 << 17  # spans retained per shard for linking
+    ring_capacity: int = 1 << 18  # spans retained per shard for linking
+    # time-bucketed retention (the daily-index / daily-dependency-table
+    # analog): D rollup slots of bucket_minutes each for link matrices,
+    # T slices of slice_minutes each for windowed histograms. A slot/slice
+    # is recycled when a newer epoch maps onto it, so coverage is the most
+    # recent D*bucket_minutes / T*slice_minutes of traffic.
+    link_buckets: int = 16
+    bucket_minutes: int = 60
+    hist_slices: int = 8
+    hist_slice_minutes: int = 60
 
     @property
     def hll_rows(self) -> int:
@@ -58,10 +78,19 @@ class AggConfig:
     def global_hll_row(self) -> int:
         return self.max_services
 
+    @property
+    def rollup_segment(self) -> int:
+        """Ring slots linked+invalidated per rollup: half the ring. The
+        host triggers a rollup before writes since the last one exceed
+        this, so no valid span is ever overwritten unrolled."""
+        return self.ring_capacity // 2
+
 
 class AggState(NamedTuple):
     hll: jnp.ndarray  # u8 [services+1, m]
-    hist: jnp.ndarray  # u32 [keys, BUCKETS]
+    hist: jnp.ndarray  # u32 [keys, BUCKETS] (all-time)
+    hist_t: jnp.ndarray  # u32 [T, keys, BUCKETS] (time slices)
+    hist_t_epoch: jnp.ndarray  # i32 [T] — absolute slice epoch held, -1 empty
     digest: jnp.ndarray  # f32 [keys, C, 2]
     pend_key: jnp.ndarray  # i32 [P] — -1 = empty lane
     pend_val: jnp.ndarray  # f32 [P]
@@ -81,7 +110,15 @@ class AggState(NamedTuple):
     r_err: jnp.ndarray  # bool
     r_ts_min: jnp.ndarray  # u32
     r_valid: jnp.ndarray  # bool
+    # rolled lanes already contributed their links to the rollup matrices:
+    # they no longer EMIT edges but stay JOIN-VISIBLE (a live child can
+    # still resolve a rolled parent until the lane is overwritten)
+    r_rolled: jnp.ndarray  # bool
     ring_pos: jnp.ndarray  # i32 scalar
+    # time-bucketed link rollups (daily dependency-table analog)
+    rollup_calls: jnp.ndarray  # u32 [D, S, S]
+    rollup_errs: jnp.ndarray  # u32 [D, S, S]
+    rollup_epoch: jnp.ndarray  # i32 [D] — absolute bucket held, -1 empty
     counters: jnp.ndarray  # u32 [NUM_COUNTERS]
 
 
@@ -91,6 +128,10 @@ def init_state(config: AggConfig) -> AggState:
     return AggState(
         hll=jnp.zeros((config.hll_rows, 1 << config.hll_precision), jnp.uint8),
         hist=jnp.zeros((config.max_keys, histogram.BUCKETS), jnp.uint32),
+        hist_t=jnp.zeros(
+            (config.hist_slices, config.max_keys, histogram.BUCKETS), jnp.uint32
+        ),
+        hist_t_epoch=jnp.full((config.hist_slices,), -1, jnp.int32),
         digest=jnp.zeros((config.max_keys, config.digest_centroids, 2), jnp.float32),
         pend_key=jnp.full((config.digest_buffer,), -1, jnp.int32),
         pend_val=jnp.zeros((config.digest_buffer,), jnp.float32),
@@ -104,7 +145,17 @@ def init_state(config: AggConfig) -> AggState:
         r_err=jnp.zeros((r,), bool),
         r_ts_min=z32,
         r_valid=jnp.zeros((r,), bool),
+        r_rolled=jnp.zeros((r,), bool),
         ring_pos=jnp.zeros((), jnp.int32),
+        rollup_calls=jnp.zeros(
+            (config.link_buckets, config.max_services, config.max_services),
+            jnp.uint32,
+        ),
+        rollup_errs=jnp.zeros(
+            (config.link_buckets, config.max_services, config.max_services),
+            jnp.uint32,
+        ),
+        rollup_epoch=jnp.full((config.link_buckets,), -1, jnp.int32),
         counters=jnp.zeros((NUM_COUNTERS,), jnp.uint32),
     )
 

@@ -24,10 +24,12 @@ double-count — the documented trade, testable against the oracle.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zipkin_tpu.internal.dates import epoch_minutes
 from zipkin_tpu.model.span import DependencyLink, Span
 from zipkin_tpu.ops import histogram as hist_ops
 from zipkin_tpu.ops import hll as hll_ops
@@ -94,7 +96,7 @@ class TpuStorage(
         # latency, so bigger device batches win nearly linearly; the only
         # hard bound is the digest pending buffer (dynamic_update_slice of
         # a batch bigger than it cannot trace).
-        bound = min(self.config.digest_buffer, 65536)
+        bound = min(self.config.digest_buffer, self.config.rollup_segment, 65536)
         self.max_batch = (bound // pad_to_multiple) * pad_to_multiple
         if self.max_batch <= 0:
             raise ValueError(
@@ -229,9 +231,9 @@ class TpuStorage(
 
     def get_dependencies(self, end_ts: int, lookback: int) -> Call[List[DependencyLink]]:
         def run() -> List[DependencyLink]:
-            lo_min = max((end_ts - lookback) // 60_000, 0)
-            hi_min = max(end_ts // 60_000, 0)
-            calls, errors = self.agg.dependency_matrices(int(lo_min), int(hi_min))
+            lo_min = epoch_minutes(end_ts - lookback)
+            hi_min = epoch_minutes(end_ts)
+            calls, errors = self.agg.dependency_matrices(lo_min, hi_min)
             out: List[DependencyLink] = []
             for p, c in zip(*np.nonzero(calls)):
                 parent = self.vocab.services.lookup(int(p))
@@ -256,21 +258,40 @@ class TpuStorage(
         service_name: Optional[str] = None,
         span_name: Optional[str] = None,
         use_digest: bool = True,
+        end_ts: Optional[int] = None,
+        lookback: Optional[int] = None,
     ) -> List[dict]:
         """Latency percentile rows per (service, spanName) — the read the
         Lens duration-percentile context needs, served from sketches.
 
+        With ``end_ts``/``lookback`` (epoch ms, as in the query API) the
+        rows come from the time-sliced histograms — windowed percentiles,
+        covering the most recent T*slice_minutes of traffic (older
+        windows return no rows; the all-time path has no window).
         Returns dicts: {service, spanName, count, quantiles: {q: µs}}.
         """
         import jax.numpy as jnp
 
-        merged_hist, _, _ = self.agg.merged_sketches()
         qarr = jnp.asarray(np.asarray(qs, np.float32))
-        if use_digest:
-            digest = self.agg.merged_digest()
-            source_q = np.asarray(tdigest_ops.quantile(digest, qarr))
-        else:
+        if end_ts is None and lookback is not None:
+            # Zipkin query convention: endTs defaults to "now" when only
+            # lookback is given (QueryRequest semantics, SURVEY.md §2.3)
+            end_ts = int(time.time() * 1000)
+        if end_ts is not None:
+            lb = lookback if lookback is not None else end_ts
+            lo_min = epoch_minutes(end_ts - lb)
+            hi_min = epoch_minutes(end_ts)
+            merged_hist = self.agg.windowed_histograms(lo_min, hi_min)
             source_q = np.asarray(hist_ops.quantile(jnp.asarray(merged_hist), qarr))
+        else:
+            merged_hist, _, _ = self.agg.merged_sketches()
+            if use_digest:
+                digest = self.agg.merged_digest()
+                source_q = np.asarray(tdigest_ops.quantile(digest, qarr))
+            else:
+                source_q = np.asarray(
+                    hist_ops.quantile(jnp.asarray(merged_hist), qarr)
+                )
         counts = np.asarray(hist_ops.total_count(jnp.asarray(merged_hist)))
 
         want_svc = (
