@@ -198,33 +198,110 @@ def config3() -> bool:
 
 
 def config4() -> bool:
-    from zipkin_tpu.storage.tpu import TpuStorage
+    """Streaming replay + mixed Lens query load at full-size AggConfig.
+
+    Uses the line-rate JSON path (the production fast mode, sampled
+    archive on) with a pre-encoded recycled corpus, so the harness can
+    reach tens of millions of spans; queries interleave mid-stream and
+    per-type latencies are recorded against the <50ms SLO. The tunneled
+    backend adds multi-tenant phase latency a real v5e topology doesn't
+    have, so min/p50/p99 are all reported; the SLO verdict uses p50.
+    """
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu import native
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.parallel.mesh import make_mesh
     from zipkin_tpu.tpu.state import AggConfig
+    from zipkin_tpu.tpu.store import TpuStorage
 
     total = int(os.environ.get("EVAL_REPLAY_SPANS", 2_000_000))
+    batch = 65_536
     store = TpuStorage(
-        config=AggConfig(), max_span_count=100_000, num_devices=1
+        config=AggConfig(), mesh=make_mesh(1), pad_to_multiple=batch,
+        archive_max_span_count=100_000,
     )
-    start = time.perf_counter()
+    corpus = lots_of_spans(2 * batch, seed=400, services=40, span_names=80)
+    payloads = [
+        json_v2.encode_span_list(corpus[i : i + batch])
+        for i in range(0, len(corpus), batch)
+    ]
+    end_ts = max(s.timestamp for s in corpus if s.timestamp) // 1000 + 3_600_000
+    lookback = 1000 * 86_400_000
+    fast = native.available()
+    if fast:
+        store.ingest_json_fast(payloads[0])  # warm compile outside timing
+        store.agg.block_until_ready()
+        sent = batch
+    else:  # pragma: no cover - no C toolchain
+        sent = 0
+
+    lat: dict = {"dependencies": [], "percentiles": [], "windowed": [],
+                 "cardinalities": []}
+
+    def timed(kind, fn):
+        q0 = time.perf_counter()
+        fn()
+        lat[kind].append((time.perf_counter() - q0) * 1e3)
+
     batches = 0
-    q_times = []
-    for spans in _stream_corpus(total, 8192, seed=400, services=40, span_names=80):
-        store.accept(spans).execute()
+
+    def query_round():
+        # bump past the memoized results: measure device reads. (During
+        # the stream, ingest advances the version anyway; this covers the
+        # warm-up and final rounds.)
+        store.agg.write_version += 1
+        timed("dependencies",
+              lambda: store.get_dependencies(end_ts, lookback).execute())
+        timed("percentiles", lambda: store.latency_quantiles([0.5, 0.99]))
+        timed("windowed",
+              lambda: store.latency_quantiles(
+                  [0.5, 0.99], end_ts=end_ts, lookback=lookback))
+        timed("cardinalities", store.trace_cardinalities)
+
+    if fast:
+        # compile the query programs outside the timed window (first-call
+        # jit cost is not query latency)
+        query_round()
+        for v in lat.values():
+            v.clear()
+
+    warm = sent  # spans ingested before the timed window opened
+    start = time.perf_counter()
+    while sent < total:
+        if fast:
+            n, _ = store.ingest_json_fast(payloads[batches % len(payloads)])
+        else:  # pragma: no cover
+            chunk = corpus[:batch]
+            store.accept(chunk).execute()
+            n = len(chunk)
+        sent += n
         batches += 1
-        if batches % 16 == 0:  # mixed query load mid-stream
-            q0 = time.perf_counter()
-            store.get_dependencies(2**40, 2**40 - 60_000).execute()
-            store.latency_quantiles([0.5, 0.99], use_digest=False)
-            store.trace_cardinalities()
-            q_times.append(time.perf_counter() - q0)
+        if batches % 8 == 0:  # mixed query load mid-stream
+            query_round()
+    store.agg.block_until_ready()
+    if not lat["dependencies"]:
+        query_round()  # never skip the query half at small smoke scales
     elapsed = time.perf_counter() - start
+
+    def stats(xs):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return {"min": round(xs[0], 1), "p50": round(xs[len(xs) // 2], 1),
+                "p99": round(xs[min(len(xs) - 1, int(len(xs) * 0.99))], 1)}
+
     counters = store.ingest_counters()
-    ok = counters["spans"] == total
-    _emit(config="config4", passed=ok, spans=total,
-          sustained_spans_per_sec=round(total / elapsed),
-          query_rounds=len(q_times),
-          mean_query_round_ms=round(float(np.mean(q_times)) * 1e3, 1) if q_times else None)
-    return ok
+    q_stats = {k: stats(v) for k, v in lat.items()}
+    slo_ok = all(s is None or s["p50"] < 50.0 for s in q_stats.values())
+    trace_readable = bool(store.get_service_names().execute())
+    ok = counters["spans"] == sent and bool(lat["dependencies"])
+    _emit(config="config4", passed=bool(ok and slo_ok), spans=sent,
+          fast_path=fast,
+          sustained_spans_per_sec=round((sent - warm) / elapsed),
+          query_rounds=len(lat["dependencies"]),
+          query_latency_ms=q_stats, slo_p50_under_50ms=slo_ok,
+          archive_readable_in_fast_mode=trace_readable)
+    return bool(ok and slo_ok)
 
 
 ALL = {"config0": config0, "config1": config1, "config2": config2,

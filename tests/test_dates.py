@@ -1,8 +1,12 @@
-"""DateUtil-analog semantics (zipkin2/internal/DateUtil.java parity)."""
+"""DateUtil-analog semantics (zipkin2/internal/DateUtil.java parity —
+the helpers live in zipkin_tpu.internal.hex alongside the other
+reference internal-utils ports)."""
 
-from zipkin_tpu.internal.dates import (
+import pytest
+
+from zipkin_tpu.internal.hex import (
     DAY_MS,
-    epoch_days,
+    epoch_day_buckets,
     epoch_minutes,
     midnight_utc,
 )
@@ -21,22 +25,24 @@ def test_midnight_utc_on_boundary_is_identity():
     assert midnight_utc(m) == m
 
 
-def test_epoch_days_enumerates_inclusive():
+def test_epoch_day_buckets_enumerates_inclusive():
     end = 1577972700000  # Jan 2
-    days = epoch_days(end, 2 * DAY_MS)
+    days = epoch_day_buckets(end, 2 * DAY_MS)
     assert len(days) == 3  # Dec 31, Jan 1, Jan 2
     assert all(d % DAY_MS == 0 for d in days)
     assert days[-1] == midnight_utc(end)
     assert days[0] == midnight_utc(end - 2 * DAY_MS)
 
 
-def test_epoch_days_zero_lookback_is_one_day():
-    end = 1577972700000
-    assert epoch_days(end, 0) == [midnight_utc(end)]
+def test_epoch_day_buckets_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        epoch_day_buckets(0, DAY_MS)
+    with pytest.raises(ValueError):
+        epoch_day_buckets(DAY_MS, 0)
 
 
-def test_epoch_days_clamps_negative_start():
-    days = epoch_days(DAY_MS // 2, 10 * DAY_MS)
+def test_epoch_day_buckets_clamps_negative_start():
+    days = epoch_day_buckets(DAY_MS // 2, 10 * DAY_MS)
     assert days[0] == 0
 
 

@@ -1,0 +1,90 @@
+"""Fast-ingest mode must stay queryable: a trace-affine sample of raw
+spans is archived at full fidelity (VERDICT r1 item 6 — previously the
+bench configuration and the queryable configuration were different
+systems: TPU_FAST_INGEST skipped the archive entirely, so
+``/api/v2/trace/{id}`` returned nothing)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.fixtures import TRACE, lots_of_spans
+from zipkin_tpu import native
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.parallel.mesh import make_mesh
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+SMALL = AggConfig(
+    max_services=64, max_keys=256, hll_precision=8, digest_centroids=16,
+    digest_buffer=4096, ring_capacity=4096, link_buckets=4, hist_slices=2,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native codec unavailable"
+)
+
+
+def make_store(every):
+    return TpuStorage(
+        config=SMALL, mesh=make_mesh(1), pad_to_multiple=256,
+        fast_archive_sample=every,
+    )
+
+
+def test_sampled_trace_readable_at_full_fidelity():
+    store = make_store(1)  # archive every trace
+    payload = json_v2.encode_span_list(TRACE)
+    accepted, dropped = store.ingest_json_fast(payload)
+    assert accepted == len(TRACE) and dropped == 0
+
+    got = store.get_trace(TRACE[0].trace_id).execute()
+    assert len(got) == len(TRACE)
+    # full fidelity: tags and annotations survive (the columnar fast path
+    # itself drops them; the archive re-decodes the raw slices)
+    by_id = {(s.id, bool(s.shared)): s for s in got}
+    for want in TRACE:
+        have = by_id[(want.id, bool(want.shared))]
+        assert have.tags == want.tags
+        assert have.annotations == want.annotations
+        assert have.local_endpoint == want.local_endpoint
+
+    # search works in fast mode too
+    from zipkin_tpu.storage.spi import QueryRequest
+
+    svc = TRACE[0].local_service_name
+    res = store.get_traces_query(
+        QueryRequest(
+            service_name=svc, end_ts=2**53 // 1000, lookback=2**53 // 1000,
+            limit=10,
+        )
+    ).execute()
+    assert res and any(s.trace_id == TRACE[0].trace_id for t in res for s in t)
+
+
+def test_sampling_is_trace_affine_and_partial():
+    store = make_store(4)  # 1 in 4 traces
+    spans = lots_of_spans(2000, seed=13, services=5, span_names=8)
+    payload = json_v2.encode_span_list(spans)
+    store.ingest_json_fast(payload)
+
+    all_tids = {s.trace_id for s in spans}
+    by_tid = {}
+    for s in spans:
+        by_tid.setdefault(s.trace_id, []).append(s)
+    archived = [t for t in all_tids if store.get_trace(t).execute()]
+    frac = len(archived) / len(all_tids)
+    assert 0.1 < frac < 0.5, f"expected ~1/4 of traces archived, got {frac}"
+    # affinity: an archived trace is COMPLETE (merge semantics may dedup
+    # shared client/server renditions, so compare distinct ids)
+    for t in archived[:20]:
+        got_ids = {(s.id, bool(s.shared)) for s in store.get_trace(t).execute()}
+        want_ids = {(s.id, bool(s.shared)) for s in by_tid[t]}
+        assert got_ids == want_ids
+
+
+def test_disable_with_zero():
+    store = make_store(0)
+    payload = json_v2.encode_span_list(TRACE)
+    store.ingest_json_fast(payload)
+    assert store.get_trace(TRACE[0].trace_id).execute() == []

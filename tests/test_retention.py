@@ -202,4 +202,6 @@ class TestRollupSlotRecycling:
         store.agg.rollup_now()
         store.agg.rollup_now()
         store.agg.rollup_now()
+        # rollup_now bumps the aggregator write_version, so this read
+        # recomputes on device rather than serving the cached result
         assert link_set(store, end_ts, WIDE_LOOKBACK) == before

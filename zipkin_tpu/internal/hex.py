@@ -89,6 +89,13 @@ def midnight_utc(epoch_ms: int) -> int:
     return epoch_ms - (epoch_ms % DAY_MS)
 
 
+def epoch_minutes(epoch_ms: int) -> int:
+    """Epoch minutes — the device tier's time unit (ring ``ts_min``,
+    rollup/slice bucket inputs); clamped at 0. The single ms-to-minute
+    conversion point for query windows (TpuStorage)."""
+    return max(int(epoch_ms) // 60_000, 0)
+
+
 def epoch_day_buckets(end_ts_ms: int, lookback_ms: int) -> List[int]:
     """All UTC-day bucket start times covering ``(end_ts - lookback, end_ts]``.
 

@@ -100,6 +100,7 @@ def _load():
             + [u8p] * 4   # shared, kind, err, has_dur
             + [u64p, u32p, u8p]  # ts, dur, debug
             + [u32p] * 6  # string slices
+            + [u32p] * 2  # span byte extents
         )
         lib.zt_parse_spans.restype = ctypes.c_long
         lib.zt_parse_spans.argtypes = base
@@ -145,6 +146,7 @@ class ParsedColumns:
         "data", "n", "tl0", "tl1", "th0", "th1", "s0", "s1", "p0", "p1",
         "shared", "kind", "err", "has_dur", "ts_us", "dur_us", "debug",
         "svc_off", "svc_len", "rsvc_off", "rsvc_len", "name_off", "name_len",
+        "span_off", "span_len",
         "svc_id", "rsvc_id", "name_id", "key_id",
     )
 
@@ -293,6 +295,7 @@ def parse_spans(
     out.svc_off, out.svc_len = u32(), u32()
     out.rsvc_off, out.rsvc_len = u32(), u32()
     out.name_off, out.name_len = u32(), u32()
+    out.span_off, out.span_len = u32(), u32()
 
     p32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
     p8 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
@@ -307,6 +310,7 @@ def parse_spans(
         p32(out.svc_off), p32(out.svc_len),
         p32(out.rsvc_off), p32(out.rsvc_len),
         p32(out.name_off), p32(out.name_len),
+        p32(out.span_off), p32(out.span_len),
     )
     if nvocab is not None:
         out.svc_id = np.zeros(cap, np.int32)

@@ -38,6 +38,10 @@ typedef struct {
   uint32_t *svc_off, *svc_len;
   uint32_t *rsvc_off, *rsvc_len;
   uint32_t *name_off, *name_len;
+  /* byte extent of each span's own JSON object in the input: lets the
+   * caller re-decode an exact sampled subset at full fidelity (tags,
+   * annotations) without re-parsing the whole payload */
+  uint32_t *span_off, *span_len;
 } columns_t;
 
 typedef struct {
@@ -282,6 +286,7 @@ static int read_bool(cursor_t *c, uint8_t *out) {
 static int parse_span(cursor_t *c, columns_t *cols, long i) {
   skip_ws(c);
   if (c->pos >= c->n || c->buf[c->pos] != '{') return ERR_SYNTAX;
+  cols->span_off[i] = (uint32_t)c->pos;
   c->pos++;
   skip_ws(c);
   if (c->pos < c->n && c->buf[c->pos] == '}') return ERR_SYNTAX; /* id req */
@@ -350,6 +355,7 @@ static int parse_span(cursor_t *c, columns_t *cols, long i) {
     if (c->buf[c->pos] == '}') { c->pos++; break; }
     return ERR_SYNTAX;
   }
+  cols->span_len[i] = (uint32_t)(c->pos - cols->span_off[i]);
   return (have_trace && have_id) ? 0 : ERR_SYNTAX;
 }
 
@@ -363,11 +369,12 @@ long zt_parse_spans(const uint8_t *buf, size_t n, long cap,
                     uint8_t *debug_flag,
                     uint32_t *svc_off, uint32_t *svc_len,
                     uint32_t *rsvc_off, uint32_t *rsvc_len,
-                    uint32_t *name_off, uint32_t *name_len) {
+                    uint32_t *name_off, uint32_t *name_len,
+                    uint32_t *span_off, uint32_t *span_len) {
   columns_t cols = {
     tl0, tl1, th0, th1, s0, s1, p0, p1, shared_flag, kind, err, has_dur,
     ts_us, dur_us, debug_flag, svc_off, svc_len, rsvc_off, rsvc_len,
-    name_off, name_len,
+    name_off, name_len, span_off, span_len,
   };
   cursor_t c = {buf, 0, n};
   skip_ws(&c);
@@ -598,11 +605,12 @@ long zt_parse_spans_interned(
     uint32_t *svc_off, uint32_t *svc_len,
     uint32_t *rsvc_off, uint32_t *rsvc_len,
     uint32_t *name_off, uint32_t *name_len,
+    uint32_t *span_off, uint32_t *span_len,
     int32_t *svc_id, int32_t *rsvc_id, int32_t *name_id, int32_t *key_id) {
   long count = zt_parse_spans(buf, n, cap, tl0, tl1, th0, th1, s0, s1, p0, p1,
                               shared_flag, kind, err, has_dur, ts_us, dur_us,
                               debug_flag, svc_off, svc_len, rsvc_off, rsvc_len,
-                              name_off, name_len);
+                              name_off, name_len, span_off, span_len);
   if (count <= 0 || vocabp == NULL) return count;
   vocab_t *v = (vocab_t *)vocabp;
   for (long i = 0; i < count; i++) {

@@ -161,7 +161,116 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
             in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(),
         )
     )
-    return init, step, links, merge, flush, rollup, whist, sharding
+
+    def _merged_digest_of(state: AggState):
+        """Complete cross-shard digest as a PURE READ: fold each shard's
+        pending points into a local partial (state untouched — a
+        percentile query no longer stalls ingest with a flush-on-read),
+        all_gather the per-shard digests over ICI, recluster row-wise."""
+        from zipkin_tpu.ops import tdigest
+
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        w = (s.pend_key >= 0).astype(jnp.float32)
+        keys = jnp.clip(s.pend_key, 0, config.max_keys - 1)
+        partial = tdigest.compact_points(
+            keys, s.pend_val, w, config.max_keys, config.digest_centroids
+        )
+        local = tdigest.row_merge(s.digest, partial)  # [K, C, 2]
+        allc = jax.lax.all_gather(local, SHARD_AXIS)  # [D, K, C, 2]
+        d = allc.shape[0]
+        k = config.max_keys
+        c = config.digest_centroids
+        flat = jnp.moveaxis(allc, 0, 1).reshape(k, d * c, 2)
+        return tdigest.row_merge(jnp.zeros((k, c, 2), jnp.float32), flat)
+
+    # replication can't be statically inferred through all_gather+row_merge
+    _vma_off = dict(check_vma=False)
+
+    digest_read = jax.jit(
+        shard_map(
+            _merged_digest_of, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+            out_specs=P(), **_vma_off,
+        )
+    )
+
+    # quantile reads computed ON DEVICE: one dispatch, [K, Q] + [K] counts
+    # over the tunnel instead of the dense [K, BUCKETS] histogram (28MB at
+    # default shapes — the round-1 query path pulled it per request)
+    def spmd_quant_digest(state: AggState, qs):
+        from zipkin_tpu.ops import histogram, tdigest
+
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        merged = _merged_digest_of(state)
+        counts = jax.lax.psum(histogram.total_count(s.hist), SHARD_AXIS)
+        return tdigest.quantile(merged, qs), counts
+
+    quant_digest = jax.jit(
+        shard_map(
+            spmd_quant_digest, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P()), out_specs=P(), **_vma_off,
+        )
+    )
+
+    def spmd_quant_hist(state: AggState, qs):
+        from zipkin_tpu.ops import histogram
+
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        merged = jax.lax.psum(s.hist, SHARD_AXIS)
+        return histogram.quantile(merged, qs), histogram.total_count(merged)
+
+    quant_hist = jax.jit(
+        shard_map(
+            spmd_quant_hist, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P()), out_specs=P(),
+        )
+    )
+
+    def spmd_quant_whist(state: AggState, ts_lo, ts_hi, qs):
+        from zipkin_tpu.ops import histogram
+
+        merged = spmd_whist(state, ts_lo, ts_hi)
+        return histogram.quantile(merged, qs), histogram.total_count(merged)
+
+    quant_whist = jax.jit(
+        shard_map(
+            spmd_quant_whist, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(), P(), P()), out_specs=P(),
+        )
+    )
+
+    # dependency edges compacted ON DEVICE: top-E cells of the merged
+    # [S, S] call matrix (an [S^2] top_k), so a query ships 3 small [E]
+    # vectors over the tunnel instead of two dense matrices
+    num_edges = min(4096, config.max_services * config.max_services)
+
+    def spmd_edges(state: AggState, ts_lo, ts_hi):
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi)
+        calls = jax.lax.psum(calls, SHARD_AXIS).reshape(-1)
+        errors = jax.lax.psum(errors, SHARD_AXIS).reshape(-1)
+        top, idx = jax.lax.top_k(calls, num_edges)
+        return idx, top, errors[idx]
+
+    edges = jax.jit(
+        shard_map(
+            spmd_edges, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(),
+        )
+    )
+    def spmd_card(state: AggState):
+        from zipkin_tpu.ops import hll as hll_ops
+
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        merged = jax.lax.pmax(s.hll, SHARD_AXIS)
+        return hll_ops.estimate(merged)  # [S+1] f32 — KBs, not registers
+
+    card = jax.jit(
+        shard_map(spmd_card, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
+    )
+    return (
+        init, step, links, merge, flush, rollup, whist, digest_read, edges,
+        quant_digest, quant_hist, quant_whist, card, sharding,
+    )
 
 
 class ShardedAggregator:
@@ -177,7 +286,9 @@ class ShardedAggregator:
         self.n_shards = int(np.prod(mesh.devices.shape))
         (
             init, self._step, self._links, self._merge, self._flush,
-            self._rollup, self._whist, self._sharding,
+            self._rollup, self._whist, self._digest_read, self._edges,
+            self._quant_digest, self._quant_hist, self._quant_whist,
+            self._card, self._sharding,
         ) = _compiled_programs(config, mesh)
         self.state: AggState = init()
         # Exact host-side counters: the device counters are u32 and wrap
@@ -207,6 +318,11 @@ class ShardedAggregator:
         # cursor, so spans are never overwritten before their links are
         # folded into the time-bucketed rollup matrices.
         self._lanes_since_rollup = 0
+        # Monotonic counter bumped on EVERY state mutation (step, flush,
+        # rollup, restore) — the read-cache invalidation key. Batch count
+        # alone is not enough: rollup_now()/flush change query-visible
+        # state without a new batch.
+        self.write_version = 0
 
     # -- write path ------------------------------------------------------
 
@@ -233,6 +349,7 @@ class ShardedAggregator:
             self.state = self._step(self.state, device_batch)
             self._pend_lanes += lanes
             self._lanes_since_rollup += lanes
+            self.write_version += 1
             c = self.host_counters
             c["spans"] += int(cols.valid.sum())
             c["spansWithDuration"] += int((cols.valid & cols.has_dur).sum())
@@ -257,17 +374,27 @@ class ShardedAggregator:
             return np.asarray(calls), np.asarray(errors)
 
     def merged_digest(self) -> jnp.ndarray:
-        """[K, C, 2] t-digest merged across shards (host-side compaction).
+        """[K, C, 2] t-digest merged across shards in ONE device dispatch.
 
-        Flushes each shard's pending buffer first so reads are complete —
-        a state WRITE, hence the lock.
+        A PURE READ: each shard's pending points are folded into a
+        temporary partial on device (state untouched — no flush-on-read
+        stalling ingest), shards all_gather over ICI, one row-parallel
+        recluster, and only the final [K, C, 2] crosses to the host.
         """
-        from zipkin_tpu.ops import tdigest
-
         with self.lock:
-            self._flush_now()
-            stacked = np.asarray(self.state.digest)  # [D, K, C, 2]
-        return tdigest.merge_many(stacked)
+            return self._digest_read(self.state)
+
+    def dependency_edges(
+        self, ts_lo_min: int, ts_hi_min: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(flat_index, calls, errors) [E] — the nonzero-dominant cells of
+        the merged link matrix, compacted on device (top-E by call count)
+        so a dependency query pulls ~KBs, not two dense [S, S] matrices."""
+        with self.lock:
+            idx, calls, errors = self._edges(
+                self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
+            )
+            return np.asarray(idx), np.asarray(calls), np.asarray(errors)
 
     def _flush_now(self) -> None:
         """Compact the pending digest buffer and reset the host mirror —
@@ -275,6 +402,7 @@ class ShardedAggregator:
         mirror reset are one invariant). Callers hold the lock."""
         self.state = self._flush(self.state)
         self._pend_lanes = 0
+        self.write_version += 1
 
     def rollup_now(self) -> None:
         """Run the link-rollup program (rollup_step) and reset the
@@ -282,6 +410,7 @@ class ShardedAggregator:
         with self.lock:
             self.state = self._rollup(self.state)
             self._lanes_since_rollup = 0
+            self.write_version += 1
 
     def windowed_histograms(self, ts_lo_min: int, ts_hi_min: int) -> np.ndarray:
         """[K, BUCKETS] histogram over the window, merged across shards
@@ -292,6 +421,35 @@ class ShardedAggregator:
             )
             return np.asarray(out)
 
+    def quantiles(
+        self,
+        qs,
+        source: str = "digest",
+        ts_lo_min: Optional[int] = None,
+        ts_hi_min: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """([K, Q] quantiles, [K] counts) computed ON device in a single
+        dispatch; ``source`` is "digest" or "hist"; a (ts_lo_min,
+        ts_hi_min) window uses the time-sliced histograms."""
+        qarr = jnp.asarray(np.asarray(qs, np.float32))
+        with self.lock:
+            if ts_lo_min is not None:
+                q, n = self._quant_whist(
+                    self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min),
+                    qarr,
+                )
+            elif source == "digest":
+                q, n = self._quant_digest(self.state, qarr)
+            else:
+                q, n = self._quant_hist(self.state, qarr)
+            return np.asarray(q), np.asarray(n)
+
+    def cardinalities(self) -> np.ndarray:
+        """[S+1] HLL distinct-trace estimates (last row global), computed
+        on device — only the estimates cross the tunnel, not registers."""
+        with self.lock:
+            return np.asarray(self._card(self.state))
+
     def sync_pend_lanes(self) -> None:
         """Re-derive the host pend mirror from device state (call after
         replacing ``self.state`` wholesale, e.g. snapshot restore)."""
@@ -300,6 +458,7 @@ class ShardedAggregator:
             # write distance since the last rollup is not recorded in
             # state; assume the worst so the next batch rolls up first
             self._lanes_since_rollup = self.config.rollup_segment
+            self.write_version += 1
 
     def state_arrays(self) -> list:
         """Consistent host copy of every state leaf (snapshot path)."""

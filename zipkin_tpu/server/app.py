@@ -71,6 +71,7 @@ def build_storage(config: ServerConfig) -> StorageComponent:
             num_devices=config.tpu_devices,
             checkpoint_dir=config.tpu_checkpoint_dir,
             config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
+            fast_archive_sample=config.tpu_fast_archive_sample,
             **common,
         )
     raise ValueError(f"unknown STORAGE_TYPE: {config.storage_type}")

@@ -64,7 +64,8 @@ class ServerConfig:
     # TPU aggregation tier
     tpu_devices: Optional[int] = None  # None = all visible
     tpu_batch_size: int = 8192
-    tpu_fast_ingest: bool = False  # line-rate JSON->device path, no archive
+    tpu_fast_ingest: bool = False  # line-rate JSON->device path
+    tpu_fast_archive_sample: int = 64  # 1/N traces archived in fast mode
     tpu_checkpoint_dir: Optional[str] = None
     # device state shape (see zipkin_tpu.tpu.state.AggConfig); None =
     # AggConfig's default for that field
@@ -95,6 +96,7 @@ class ServerConfig:
             tpu_devices=_env_int("TPU_DEVICES", 0) or None,
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_fast_ingest=_env_bool("TPU_FAST_INGEST", False),
+            tpu_fast_archive_sample=_env_int("TPU_FAST_ARCHIVE_SAMPLE", 64),
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
             tpu_agg=_env_agg(),
         )

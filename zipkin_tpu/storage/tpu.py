@@ -27,6 +27,7 @@ class TpuStorage(_CoreTpuStorage):
         strict_trace_id: bool = True,
         search_enabled: bool = True,
         autocomplete_keys: Sequence[str] = (),
+        fast_archive_sample: int = 64,
     ) -> None:
         mesh = None
         if num_devices is not None:
@@ -41,6 +42,7 @@ class TpuStorage(_CoreTpuStorage):
             autocomplete_keys=autocomplete_keys,
             archive_max_span_count=max_span_count,
             pad_to_multiple=min(batch_size, 1024),
+            fast_archive_sample=fast_archive_sample,
         )
         self.batch_size = batch_size
         self.checkpoint_dir = checkpoint_dir

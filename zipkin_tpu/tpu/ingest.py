@@ -211,8 +211,9 @@ def ring_link_input(state: AggState) -> linker.LinkInput:
 
 def rollup_step(config: AggConfig, state: AggState) -> AggState:
     """Link the half-ring the cursor will overwrite next and fold the
-    edges into per-time-bucket rollup matrices, then invalidate those
-    ring lanes.
+    edges into per-time-bucket rollup matrices, then mark those lanes
+    rolled (they stop emitting edges but stay JOIN-VISIBLE until
+    physically overwritten, so live children still resolve them).
 
     This is the reference's zipkin-dependencies batch job run on-device
     ahead of eviction (SURVEY.md §3.5): links are attributed to the

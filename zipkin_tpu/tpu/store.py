@@ -23,17 +23,15 @@ double-count — the documented trade, testable against the oracle.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from zipkin_tpu.internal.dates import epoch_minutes
+from zipkin_tpu.internal.hex import epoch_minutes
 from zipkin_tpu.model.span import DependencyLink, Span
-from zipkin_tpu.ops import histogram as hist_ops
-from zipkin_tpu.ops import hll as hll_ops
-from zipkin_tpu.ops import tdigest as tdigest_ops
 from zipkin_tpu.storage.memory import InMemoryStorage
 from zipkin_tpu.storage.spi import (
     AutocompleteTags,
@@ -48,12 +46,15 @@ from zipkin_tpu.tpu.state import AggConfig
 from zipkin_tpu.utils.call import Call
 from zipkin_tpu.utils.component import CheckResult, Component
 
+logger = logging.getLogger(__name__)
+
 
 _PARSED_FIELDS = (
     "tl0", "tl1", "th0", "th1", "s0", "s1", "p0", "p1",
     "shared", "kind", "err", "has_dur", "ts_us", "dur_us",
     "debug", "svc_off", "svc_len", "rsvc_off", "rsvc_len",
-    "name_off", "name_len", "svc_id", "rsvc_id", "name_id", "key_id",
+    "name_off", "name_len", "span_off", "span_len",
+    "svc_id", "rsvc_id", "name_id", "key_id",
 )
 
 
@@ -70,6 +71,7 @@ class TpuStorage(
         autocomplete_keys: Sequence[str] = (),
         archive_max_span_count: int = 500_000,
         pad_to_multiple: int = 1024,
+        fast_archive_sample: int = 64,
     ) -> None:
         from zipkin_tpu.parallel.sharded import ShardedAggregator
 
@@ -104,11 +106,19 @@ class TpuStorage(
                 f"pad_to_multiple ({pad_to_multiple})"
             )
         self._closed = False
+        # fast-mode archive sampling: 1 in N traces keeps full raw spans
+        # (0 disables). Trace-affine so sampled traces are COMPLETE.
+        self._fast_archive_every = fast_archive_sample
         # interning id-space coherence: the C-side vocab (fast path) and
         # the Python vocab (object path) assign ids sequentially; any
         # operation that interns must hold this lock so the orders match.
         self._intern_lock = threading.RLock()
         self._nvocab = None
+        # read cache: device pulls (merged digest/sketches) keyed by the
+        # write version, so repeated queries between writes cost nothing
+        self._read_cache: dict = {}
+        self._read_cache_version = -1
+        self._read_cache_lock = threading.Lock()
 
     # -- SPI factories ---------------------------------------------------
 
@@ -144,9 +154,14 @@ class TpuStorage(
 
     def ingest_json_fast(self, data: bytes, sampler=None):
         """Line-rate ingest: raw JSON v2 bytes -> device aggregates via the
-        native columnar parser, skipping Span objects AND the host archive
-        (the aggregate tier is the product at this rate; raw-span retention
-        at line rate is delegated, as in the reference, to row storage).
+        native columnar parser, skipping Span objects for the bulk of the
+        stream. A trace-affine 1/N sample IS archived at full fidelity
+        (the parser records each span's byte extent; sampled slices are
+        re-decoded by the reference codec), so ``/api/v2/trace/{id}`` and
+        search stay alive in fast mode — the round-1 gap where the
+        benchmark configuration and the queryable configuration were
+        different systems. N = TPU_FAST_ARCHIVE_SAMPLE (default 64,
+        0 disables).
 
         Returns (accepted, sample_dropped), or None when the native path
         can't take this payload (caller falls back to the object path).
@@ -186,6 +201,7 @@ class TpuStorage(
                 parsed.n = n = len(idx)
         if n == 0:
             return 0, dropped
+        self._archive_fast_sample(parsed, n)
         for lo_i in range(0, n, self.max_batch):
             hi_i = min(lo_i + self.max_batch, n)
             if lo_i == 0 and hi_i == n:
@@ -200,6 +216,35 @@ class TpuStorage(
             cols = pack_parsed(sub, self.vocab, self._pad)
             self.agg.ingest(cols)
         return n, dropped
+
+    def _archive_fast_sample(self, parsed, n: int) -> None:
+        """Archive a trace-affine 1/N sample of a fast-ingest batch at
+        full fidelity by re-decoding each sampled span's exact JSON slice
+        (extents recorded by the native parser)."""
+        every = self._fast_archive_every
+        if every <= 0:
+            return
+        from zipkin_tpu.model import json_v2
+        from zipkin_tpu.tpu.columnar import _mix32
+
+        tid = (
+            parsed.tl0[:n] ^ parsed.tl1[:n] ^ parsed.th0[:n] ^ parsed.th1[:n]
+        )
+        pick = np.nonzero(_mix32(tid) % np.uint32(every) == 0)[0]
+        if not len(pick):
+            return
+        data = parsed.data
+        off, ln = parsed.span_off, parsed.span_len
+        spans = []
+        for i in pick:
+            try:
+                spans.append(
+                    json_v2.decode_one_span(data[off[i] : off[i] + ln[i]])
+                )
+            except Exception:  # a slice the strict codec rejects: skip
+                continue
+        if spans:
+            self._archive.accept(spans).execute()
 
     # -- raw trace reads: host archive -----------------------------------
 
@@ -229,23 +274,71 @@ class TpuStorage(
 
     # -- aggregate reads: device ----------------------------------------
 
+    def _cached_read(self, key: str, compute):
+        """Memoize a device pull until the next state mutation (the
+        aggregator bumps write_version on step/flush/rollup/restore);
+        the device state is immutable between mutations. The whole cache
+        drops when the version advances — keys embed window minutes and
+        quantile lists, so per-key staleness checks alone would let dead
+        entries accumulate forever under a polling UI."""
+        version = self.agg.write_version
+        with self._read_cache_lock:
+            if self._read_cache_version != version:
+                self._read_cache.clear()
+                self._read_cache_version = version
+            hit = self._read_cache.get(key)
+            if hit is not None:
+                return hit
+        value = compute()
+        with self._read_cache_lock:
+            if self._read_cache_version == version:
+                self._read_cache[key] = value
+        return value
+
     def get_dependencies(self, end_ts: int, lookback: int) -> Call[List[DependencyLink]]:
         def run() -> List[DependencyLink]:
             lo_min = epoch_minutes(end_ts - lookback)
             hi_min = epoch_minutes(end_ts)
-            calls, errors = self.agg.dependency_matrices(lo_min, hi_min)
+            # edges compacted on device: [E] vectors, not dense [S, S]
+            idx, calls, errors = self._cached_read(
+                f"edges:{lo_min}:{hi_min}",
+                lambda: self.agg.dependency_edges(lo_min, hi_min),
+            )
+            s = self.config.max_services
+            live = calls > 0
+            if bool(live.all()) and len(calls) < s * s:
+                # every top-k slot is occupied: the graph has more edges
+                # than the compaction width — fall back to the dense
+                # matrices so no edge is silently dropped (the compact
+                # path stays the common case; real service graphs are
+                # sparse)
+                logger.debug(
+                    "dependency edge compaction full (%d); using dense pull",
+                    len(calls),
+                )
+                lo2, hi2 = lo_min, hi_min
+                dense_c, dense_e = self._cached_read(
+                    f"depmat:{lo2}:{hi2}",
+                    lambda: self.agg.dependency_matrices(lo2, hi2),
+                )
+                p_idx, c_idx = np.nonzero(dense_c)
+                flat_idx = p_idx * s + c_idx
+                idx, calls, errors = (
+                    flat_idx, dense_c[p_idx, c_idx], dense_e[p_idx, c_idx]
+                )
+                live = calls > 0
             out: List[DependencyLink] = []
-            for p, c in zip(*np.nonzero(calls)):
-                parent = self.vocab.services.lookup(int(p))
-                child = self.vocab.services.lookup(int(c))
+            for flat, n_calls, n_errs in zip(idx[live], calls[live], errors[live]):
+                parent = self.vocab.services.lookup(int(flat) // s)
+                child = self.vocab.services.lookup(int(flat) % s)
                 if not parent or not child:
                     continue
                 out.append(
                     DependencyLink(
                         parent=parent,
                         child=child,
-                        call_count=int(calls[p, c]),
-                        error_count=int(errors[p, c]),
+                        call_count=int(n_calls),
+                        error_count=int(n_errs),
                     )
                 )
             return out
@@ -270,49 +363,50 @@ class TpuStorage(
         windows return no rows; the all-time path has no window).
         Returns dicts: {service, spanName, count, quantiles: {q: µs}}.
         """
-        import jax.numpy as jnp
-
-        qarr = jnp.asarray(np.asarray(qs, np.float32))
         if end_ts is None and lookback is not None:
             # Zipkin query convention: endTs defaults to "now" when only
             # lookback is given (QueryRequest semantics, SURVEY.md §2.3)
             end_ts = int(time.time() * 1000)
+        qkey = ",".join(f"{q:.6g}" for q in qs)
         if end_ts is not None:
             lb = lookback if lookback is not None else end_ts
             lo_min = epoch_minutes(end_ts - lb)
             hi_min = epoch_minutes(end_ts)
-            merged_hist = self.agg.windowed_histograms(lo_min, hi_min)
-            source_q = np.asarray(hist_ops.quantile(jnp.asarray(merged_hist), qarr))
+            source_q, counts = self._cached_read(
+                f"quant:w:{lo_min}:{hi_min}:{qkey}",
+                lambda: self.agg.quantiles(qs, ts_lo_min=lo_min, ts_hi_min=hi_min),
+            )
         else:
-            merged_hist, _, _ = self.agg.merged_sketches()
-            if use_digest:
-                digest = self.agg.merged_digest()
-                source_q = np.asarray(tdigest_ops.quantile(digest, qarr))
-            else:
-                source_q = np.asarray(
-                    hist_ops.quantile(jnp.asarray(merged_hist), qarr)
-                )
-        counts = np.asarray(hist_ops.total_count(jnp.asarray(merged_hist)))
+            src = "digest" if use_digest else "hist"
+            source_q, counts = self._cached_read(
+                f"quant:{src}:{qkey}",
+                lambda: self.agg.quantiles(qs, source=src),
+            )
 
         want_svc = (
             self.vocab.services.get(service_name.lower()) if service_name else None
         )
         if service_name and want_svc is None:
             return []
+        # vectorized row selection over the key vocab (the round-1 per-key
+        # Python loop scanned all max_keys rows per query)
+        with self.vocab._lock:
+            pairs = np.asarray(self.vocab._key_list, np.int32)  # [num_keys, 2]
+        kids = np.arange(1, pairs.shape[0])
+        mask = counts[kids] > 0
+        if want_svc is not None:
+            mask &= pairs[kids, 0] == want_svc
+        if span_name:
+            want_name = self.vocab.span_names.get(span_name.lower())
+            if want_name is None:
+                return []
+            mask &= pairs[kids, 1] == want_name
         out = []
-        for kid in range(1, self.vocab.num_keys):
-            svc_id, name_id = self.vocab.key_pair(kid)
-            if want_svc is not None and svc_id != want_svc:
-                continue
-            name = self.vocab.span_names.lookup(name_id)
-            if span_name and name != span_name.lower():
-                continue
-            if counts[kid] == 0:
-                continue
+        for kid in kids[mask]:
             out.append(
                 {
-                    "serviceName": self.vocab.services.lookup(svc_id),
-                    "spanName": name,
+                    "serviceName": self.vocab.services.lookup(int(pairs[kid, 0])),
+                    "spanName": self.vocab.span_names.lookup(int(pairs[kid, 1])),
                     "count": int(counts[kid]),
                     "quantiles": {
                         float(q): float(source_q[kid, i]) for i, q in enumerate(qs)
@@ -323,10 +417,7 @@ class TpuStorage(
 
     def trace_cardinalities(self) -> dict:
         """Estimated distinct trace counts: {"_global": n, service: n, ...}."""
-        import jax.numpy as jnp
-
-        _, hll_regs, _ = self.agg.merged_sketches()
-        est = np.asarray(hll_ops.estimate(jnp.asarray(hll_regs)))
+        est = self._cached_read("card", self.agg.cardinalities)
         out = {"_global": float(est[self.config.global_hll_row])}
         for name in self.vocab.services.names:
             sid = self.vocab.services.get(name)
