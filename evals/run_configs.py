@@ -229,7 +229,12 @@ def config4() -> bool:
     lookback = 1000 * 86_400_000
     fast = native.available()
     if fast:
-        store.ingest_json_fast(payloads[0])  # warm compile outside timing
+        # warm EVERY program the stream can hit (step, flush, rollup) —
+        # first compiles through the remote-compile tunnel take minutes
+        # and must not land inside the measurement
+        store.ingest_json_fast(payloads[0])
+        store.agg.rollup_now()
+        store.agg.flush_now()
         store.agg.block_until_ready()
         sent = batch
     else:  # pragma: no cover - no C toolchain
@@ -294,7 +299,11 @@ def config4() -> bool:
     q_stats = {k: stats(v) for k, v in lat.items()}
     slo_ok = all(s is None or s["p50"] < 50.0 for s in q_stats.values())
     trace_readable = bool(store.get_service_names().execute())
-    ok = counters["spans"] == sent and bool(lat["dependencies"])
+    ok = (
+        counters["spans"] == sent
+        and bool(lat["dependencies"])
+        and trace_readable  # fast mode must stay queryable (r1 gap)
+    )
     _emit(config="config4", passed=bool(ok and slo_ok), spans=sent,
           fast_path=fast,
           sustained_spans_per_sec=round((sent - warm) / elapsed),

@@ -1,0 +1,261 @@
+"""Randomized parity pressure (VERDICT r1 item 10).
+
+Two fuzzers keep the oracle and the device tier honest as internals
+evolve:
+
+- **QueryRequest fuzz** — random service/span/tag/duration/window query
+  combinations against the in-memory oracle, cross-checked with a naive
+  from-scratch reimplementation of ``QueryRequest.test`` semantics
+  (SURVEY.md §2.3). Catches drift in the oracle itself, which every
+  other parity test trusts as ground truth.
+- **Linker fuzz** — random malformed span forests (missing parents,
+  dangling ids, unmated shared halves, kindless spans, messaging hops,
+  loopbacks, absent services) through the DEVICE linker (with tiny rings
+  forcing rollups) vs the host ``DependencyLinker``. The reference pins
+  these semantics in DependencyLinkerTest; random forests cover the
+  interactions the enumerated cases miss.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tests.fixtures import TODAY_US
+from zipkin_tpu.model.span import Endpoint, Kind, Span
+from zipkin_tpu.parallel.mesh import make_mesh
+from zipkin_tpu.storage.memory import InMemoryStorage
+from zipkin_tpu.storage.spi import QueryRequest
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+DAY_MS = 86_400_000
+
+
+# ---------------------------------------------------------------- queries
+
+
+def _random_spans(rng: random.Random, n_traces: int):
+    services = [f"svc{i}" for i in range(5)]
+    names = [f"op{i}" for i in range(6)]
+    spans = []
+    for t in range(1, n_traces + 1):
+        depth = rng.randint(1, 4)
+        parent = None
+        for level in range(depth):
+            svc = rng.choice(services)
+            tags = {}
+            if rng.random() < 0.3:
+                tags["error"] = rng.choice(["", "boom"])
+            if rng.random() < 0.4:
+                tags["env"] = rng.choice(["prod", "dev"])
+            sid = f"{(t << 8) + level + 1:016x}"
+            spans.append(
+                Span.create(
+                    trace_id=f"{t:016x}", id=sid, parent_id=parent,
+                    kind=rng.choice([None, Kind.CLIENT, Kind.SERVER]),
+                    name=rng.choice(names),
+                    timestamp=TODAY_US + rng.randint(0, 3_600_000_000),
+                    duration=rng.choice([None, rng.randint(1, 500_000)]),
+                    local_endpoint=Endpoint.create(svc, "10.0.0.1"),
+                    annotations=(
+                        [(TODAY_US, "ws")] if rng.random() < 0.2 else []
+                    ),
+                    tags=tags,
+                )
+            )
+            parent = sid
+    return spans
+
+
+def _naive_test(request: QueryRequest, trace) -> bool:
+    """From-scratch QueryRequest.test — deliberately independent of the
+    production implementation (different structure, same spec)."""
+    ts = [s.timestamp for s in trace if s.timestamp is not None]
+    if not ts:
+        return False
+    earliest = min(ts)
+    if not (request.end_ts - request.lookback) * 1000 <= earliest <= request.end_ts * 1000:
+        return False
+
+    svc_ok = request.service_name is None
+    remote_ok = request.remote_service_name is None
+    name_ok = request.span_name is None
+    # upstream QueryRequest.test drains a REMAINING map across the trace:
+    # each annotation-query entry may be satisfied by a different span (on
+    # the selected service), not necessarily the same one
+    remaining = dict(request.annotation_query or {})
+    dur_ok = request.min_duration is None
+
+    for s in trace:
+        on_service = (
+            request.service_name is None
+            or s.local_service_name == request.service_name
+        )
+        if s.local_service_name == request.service_name:
+            svc_ok = True
+        if not on_service:
+            continue
+        if request.remote_service_name is not None and (
+            s.remote_service_name == request.remote_service_name
+        ):
+            remote_ok = True
+        if s.name == request.span_name:
+            name_ok = True
+        if remaining:
+            have = dict(s.tags)
+            for a in s.annotations:
+                have.setdefault(a.value, "")
+            for k, v in list(remaining.items()):
+                if (have.get(k) == v) if v else (k in have):
+                    del remaining[k]
+        if request.min_duration is not None and s.duration:
+            if s.duration >= request.min_duration and (
+                request.max_duration is None or s.duration <= request.max_duration
+            ):
+                dur_ok = True
+    return svc_ok and remote_ok and name_ok and not remaining and dur_ok
+
+
+def _random_request(rng: random.Random) -> QueryRequest:
+    kw = dict(
+        end_ts=(TODAY_US + 3_600_000_000) // 1000,
+        lookback=rng.choice([DAY_MS, 3_600_000, 30 * 60_000]),
+        limit=1000,
+    )
+    if rng.random() < 0.6:
+        kw["service_name"] = f"svc{rng.randint(0, 5)}"  # may not exist
+    if rng.random() < 0.3:
+        kw["span_name"] = f"op{rng.randint(0, 7)}"
+    if rng.random() < 0.3:
+        kw["remote_service_name"] = f"svc{rng.randint(0, 5)}"
+    if rng.random() < 0.4:
+        kw["annotation_query"] = rng.choice(
+            [{"error": ""}, {"env": "prod"}, {"ws": ""}, {"env": "prod", "error": ""}]
+        )
+    if rng.random() < 0.4:
+        kw["min_duration"] = rng.choice([1, 1000, 100_000])
+        if rng.random() < 0.5:
+            kw["max_duration"] = kw["min_duration"] * rng.randint(2, 100)
+    return QueryRequest(**kw)
+
+
+def test_query_request_fuzz_oracle_vs_naive_spec():
+    rng = random.Random(1234)
+    spans = _random_spans(rng, 120)
+    oracle = InMemoryStorage(max_span_count=100_000)
+    oracle.accept(spans).execute()
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+
+    for trial in range(200):
+        req = _random_request(rng)
+        got = {t[0].trace_id for t in
+               oracle.get_traces_query(req).execute()}
+        want = {tid for tid, trace in by_trace.items() if _naive_test(req, trace)}
+        assert got == want, (trial, req, got ^ want)
+
+
+# ----------------------------------------------------------------- linker
+
+
+def _random_forest(rng: random.Random, n_traces: int):
+    """Span forests biased toward the linker's edge cases: missing and
+    dangling parents, self-parents, mateless shared halves, shared spans
+    colliding with unrelated ids, kindless spans, absent services,
+    messaging kinds, loopbacks, parent cycles.
+
+    One malformation is deliberately NOT generated: exact identity
+    duplicates — two spans with the same (id, shared, service). The host
+    merges those field-wise before linking while the device ring accepts
+    bounded double-count (the documented at-least-once trade, SURVEY.md
+    §3.3), so they are out of scope for exact parity.
+    """
+    services = [f"s{i}" for i in range(6)]
+    spans = []
+    for t in range(1, n_traces + 1):
+        tid = f"{rng.getrandbits(63) | 1:016x}"
+        n = rng.randint(1, 6)
+        ids = [f"{(t << 8) + i + 1:016x}" for i in range(n)]
+        seen_identity = set()
+        for i in range(n):
+            roll = rng.random()
+            if roll < 0.15:
+                parent = None  # root (possibly several roots)
+            elif roll < 0.25:
+                parent = f"{rng.getrandbits(63) | 1:016x}"  # dangling
+            elif roll < 0.30:
+                parent = ids[i]  # self-parent (malformed)
+            else:
+                parent = ids[rng.randrange(i)] if i else None
+            kind = rng.choice(
+                [None, Kind.CLIENT, Kind.SERVER, Kind.PRODUCER, Kind.CONSUMER]
+            )
+            svc = rng.choice(services + [None])
+            remote = rng.choice(services + [None, None])
+            shared = kind is Kind.SERVER and rng.random() < 0.4
+            if shared and i:
+                # server half of a shared pair: may or may not have a mate
+                sid = ids[rng.randrange(i)] if rng.random() < 0.6 else ids[i]
+            elif i and rng.random() < 0.06:
+                sid = ids[rng.randrange(i)]  # duplicate NON-shared id
+            else:
+                sid = ids[i]
+            if (sid, bool(shared), svc) in seen_identity:
+                sid = ids[i]  # avoid exact identity duplicates (see above)
+                if (sid, bool(shared), svc) in seen_identity:
+                    continue
+            seen_identity.add((sid, bool(shared), svc))
+            spans.append(
+                Span.create(
+                    trace_id=tid, id=sid, parent_id=parent, kind=kind,
+                    name="op",
+                    timestamp=TODAY_US + rng.randint(0, 600_000_000),
+                    duration=rng.randint(1, 100_000),
+                    local_endpoint=(
+                        Endpoint.create(svc, "10.0.0.1") if svc else None
+                    ),
+                    remote_endpoint=(
+                        Endpoint.create(remote, "10.0.0.2") if remote else None
+                    ),
+                    tags={"error": ""} if rng.random() < 0.2 else {},
+                    shared=shared,
+                )
+            )
+    return spans
+
+
+@pytest.mark.parametrize("seed", [7, 99, 2026])
+def test_linker_fuzz_device_vs_host(seed):
+    from zipkin_tpu.internal.dependency_linker import DependencyLinker
+
+    rng = random.Random(seed)
+    spans = _random_forest(rng, 150)
+
+    cfg = AggConfig(
+        max_services=32, max_keys=64, hll_precision=8, digest_centroids=16,
+        digest_buffer=2048, ring_capacity=512,  # tiny ring: forces rollups
+        link_buckets=8, bucket_minutes=60, hist_slices=2,
+    )
+    store = TpuStorage(config=cfg, mesh=make_mesh(8), pad_to_multiple=128)
+    linker = DependencyLinker()
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    for i in range(0, len(spans), 100):
+        store.accept(spans[i : i + 100]).execute()
+    for trace in by_trace.values():
+        linker.put_trace(trace)
+
+    end_ts = (TODAY_US + 700_000_000) // 1000
+    got = sorted(
+        (l.parent, l.child, l.call_count, l.error_count)
+        for l in store.get_dependencies(end_ts, 1000 * DAY_MS).execute()
+    )
+    want = sorted(
+        (l.parent, l.child, l.call_count, l.error_count)
+        for l in linker.link()
+    )
+    assert got == want

@@ -33,6 +33,18 @@ class TestTpuStorageContract(StorageContract):
         return small_store(**kwargs)
 
 
+class TestTpuStorageContractLenient(StorageContract):
+    """The WHOLE contract again with strict_trace_id=False as the default
+    — lenient 64/128-bit id collapsing is a different code path through
+    grouping and trace reads, and the reference runs its IT suite against
+    both flags (StorageComponent.Builder.strictTraceId, SURVEY.md §2.3).
+    Tests that pin the flag explicitly keep their pinned value."""
+
+    def make_storage(self, **kwargs) -> TpuStorage:
+        kwargs.setdefault("strict_trace_id", False)
+        return small_store(**kwargs)
+
+
 class TestTpuAggregateParity:
     @pytest.fixture(scope="class")
     def loaded(self):

@@ -20,9 +20,9 @@ Algorithm over a columnar span window (all arrays fixed-shape ``[n]``):
 2. **has-child** marks (scatter-max) implement rule 1 of the linker
    (a CLIENT span with children defers to its server half).
 3. **Nearest RPC ancestor** by pointer doubling: ``jump[i]`` points to the
-   nearest ancestor-or-self with a kind; squaring it ``ITERS`` times
-   resolves chains up to depth ``2**ITERS`` in O(log depth) passes —
-   the device analog of ``_find_rpc_ancestor``'s while-loop.
+   nearest ancestor-or-self with a kind; squaring it ceil(log2 n) times
+   resolves chains of any depth in O(log n) passes — the device analog
+   of ``_find_rpc_ancestor``'s while-loop.
 4. **Rule application** is a pure vectorized select emitting up to two
    edges per span (main + rule-6b backfill), then a scatter-add into the
    ``[services, services]`` call/error matrices — which merge across
@@ -41,8 +41,12 @@ import jax.numpy as jnp
 
 from zipkin_tpu.ops.segments import segment_starts
 
-# pointer-doubling passes: resolves ancestor chains up to depth 2**ITERS
-ITERS = 7
+def _doubling_passes(n: int) -> int:
+    """Pointer-doubling passes needed to resolve ancestor chains of ANY
+    depth in an n-lane window: ceil(log2(n+1)). A fixed small cap would
+    silently misclassify spans deeper than 2**cap (legit 200-deep retry
+    chains exist), dropping their edges."""
+    return max((n).bit_length(), 1)
 
 KIND_NONE, KIND_CLIENT, KIND_SERVER, KIND_PRODUCER, KIND_CONSUMER = range(5)
 
@@ -65,14 +69,22 @@ class LinkInput(NamedTuple):
     valid: jnp.ndarray  # bool — lane holds a live span
 
 
-def _run_max(values: jnp.ndarray, key_lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
-    """Per-run max of ``values`` over runs of equal composite keys (sorted)."""
-    change = jnp.zeros(values.shape[0], bool).at[0].set(True)
+def _run_starts(key_lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    change = jnp.zeros(key_lanes[0].shape[0], bool).at[0].set(True)
     for lane in key_lanes:
         change = change | jnp.asarray(segment_starts(lane))
+    return change
+
+
+def _run_min(values: jnp.ndarray, change: jnp.ndarray, none: int) -> jnp.ndarray:
+    """Per-run min of ``values`` over runs delimited by ``change`` (sorted
+    lanes). ``none`` is the empty sentinel (values >= none mean absent);
+    returns -1 for absent. Min = FIRST in insertion order, matching the
+    host tree builder's first-wins candidate choice."""
     run_id = jnp.cumsum(change.astype(jnp.int32)) - 1
-    seg = jnp.full(values.shape[0], -1, values.dtype).at[run_id].max(values)
-    return seg[run_id]
+    seg = jnp.full(values.shape[0], none, values.dtype).at[run_id].min(values)
+    out = seg[run_id]
+    return jnp.where(out >= none, -1, out)
 
 
 def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -88,49 +100,92 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
     program (PROFILE_r02.md); one sort does the work of three.
     """
     n = x.valid.shape[0]
-    trace = (x.trace_h, x.tl0, x.tl1)
     has_parent = ((x.p0 | x.p1) != 0) & x.valid
     nonshared = x.valid & ~x.shared
     sharedv = x.valid & x.shared
 
-    own_key = trace + (x.s0, x.s1)
-    parent_key = trace + (x.p0, x.p1)
-    q_valid = nonshared & has_parent
+    # Join identity: (trace_h, id). trace_h is a 32-bit avalanche hash of
+    # the FULL 128-bit trace id — dropping the exact low-64 lanes from
+    # the sort key cuts the lexsort from 6 to 4 passes, and a false join
+    # needs a 32-bit trace-hash collision AND a 64-bit span-id match
+    # within one ring (~2^-40 per colliding pair; the reference tolerates
+    # far larger sketch error elsewhere).
+    own_key = (x.trace_h, x.s0, x.s1)
+    parent_key = (x.trace_h, x.p0, x.p1)
+    # ALL spans with parents query the parent-id join — including shared
+    # halves: a shared server span prefers its same-id client half, but
+    # when that mate is absent it must fall back to its parentId exactly
+    # like SpanNode.Builder does (found by the linker fuzz: a mateless
+    # shared span previously became a root and re-attributed its edge)
+    q_valid = has_parent
 
     anyvalid = jnp.concatenate([x.valid, q_valid])
-    lanes = [
-        jnp.where(
+
+    def lane(t, q):
+        return jnp.where(
             anyvalid,
             jnp.concatenate([t.astype(jnp.uint32), q.astype(jnp.uint32)]),
             jnp.uint32(0xFFFFFFFF),
         )
-        for t, q in zip(own_key, parent_key)
-    ]
+
+    id_lanes = [lane(t, q) for t, q in zip(own_key, parent_key)]
+    # service lane: table lanes carry their OWN service, query lanes the
+    # CHILD's — so a run of the (id, svc) composite matches candidates
+    # whose service equals the child's, the endpoint-aware preference of
+    # SpanNode._choose_parent. svc is the least-significant sort key, so
+    # plain (id) runs stay contiguous and both granularities come from
+    # ONE sort.
+    svc_lane = lane(x.svc.astype(jnp.uint32), x.svc.astype(jnp.uint32))
+
     idx = jnp.arange(n, dtype=jnp.int32)
-    neg = jnp.full((n,), -1, jnp.int32)
-    val_sh = jnp.concatenate([jnp.where(sharedv, idx, -1), neg])
-    val_ns = jnp.concatenate([jnp.where(nonshared, idx, -1), neg])
+    sent = 2 * n  # run-min "absent" sentinel
+    far = jnp.full((n,), sent, jnp.int32)
+    val_sh = jnp.concatenate([jnp.where(sharedv, idx, sent), far])
+    val_ns = jnp.concatenate([jnp.where(nonshared, idx, sent), far])
 
-    order = jnp.lexsort(tuple(lanes))
-    sorted_lanes = [l[order] for l in lanes]
-    rm_sh = _run_max(val_sh[order], sorted_lanes)
-    rm_ns = _run_max(val_ns[order], sorted_lanes)
+    order = jnp.lexsort((svc_lane,) + tuple(id_lanes))
+    coarse = _run_starts([l[order] for l in id_lanes])
+    fine = coarse | jnp.asarray(segment_starts(svc_lane[order]))
+    sh_sorted = val_sh[order]
+    ns_sorted = val_ns[order]
+    results = [
+        _run_min(sh_sorted, fine, sent),   # shared, same service
+        _run_min(sh_sorted, coarse, sent),  # any shared
+        _run_min(ns_sorted, coarse, sent),  # first non-shared
+    ]
     inv = jnp.zeros(2 * n, jnp.int32)
-    un_sh = inv.at[order].set(rm_sh)
-    un_ns = inv.at[order].set(rm_ns)
+    un = [inv.at[order].set(r) for r in results]
+    sh_fine, sh_any, ns_any = un
 
-    # table half: run-max over lanes sharing MY own id
-    # query half: run-max over lanes whose own id equals MY parent id
-    j_shared = jnp.where(sharedv, un_ns[:n], -1)
-    j_to_shared = jnp.where(q_valid, un_sh[n:], -1)
-    j_to_normal = jnp.where(q_valid, un_ns[n:], -1)
-    # a span must not become its own parent (self-parent == root)
-    self_idx = idx
-    j_to_normal = jnp.where(j_to_normal == self_idx, -1, j_to_normal)
+    # Parent-id resolution in SpanNode._choose_parent preference order:
+    # 1) first shared with the child's service, 2) the FIRST non-shared
+    # (primary_by_id — the host never service-scans non-shared
+    # candidates, it checks whether THE first one's service matches),
+    # 3) first shared any service, 4) the first non-shared regardless.
+    primary = ns_any[n:]
+    primary_svc = x.svc[jnp.where(primary >= 0, primary, 0)]
+    child_svc = x.svc
+    primary_matches = (primary >= 0) & (primary_svc == child_svc)
+    by_parent_id = primary
+    by_parent_id = jnp.where(sh_any[n:] >= 0, sh_any[n:], by_parent_id)
+    by_parent_id = jnp.where(primary_matches, primary, by_parent_id)
+    by_parent_id = jnp.where(sh_fine[n:] >= 0, sh_fine[n:], by_parent_id)
+    by_parent_id = jnp.where(q_valid, by_parent_id, -1)
 
+    # shared half -> first client half with MY id (any service), else the
+    # first NON-shared span with my parent id (the host builder's shared
+    # fallback consults only primary_by_id — no endpoint preference, no
+    # shared candidates); normal span -> full parent-id preference chain
+    j_shared = jnp.where(sharedv, ns_any[:n], -1)
+    shared_fallback = jnp.where(q_valid, ns_any[n:], -1)
     parent = jnp.where(
-        sharedv, j_shared, jnp.where(j_to_shared >= 0, j_to_shared, j_to_normal)
+        sharedv,
+        jnp.where(j_shared >= 0, j_shared, shared_fallback),
+        by_parent_id,
     )
+    # a span must not become its own parent (self-parent -> dangling root,
+    # as the host builder treats a self-referential choice)
+    parent = jnp.where(parent == idx, -1, parent)
     parent = jnp.where(x.valid, parent, -1)
 
     has_child = (
@@ -139,6 +194,23 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
         .max(jnp.where(parent >= 0, 1, 0))
     )
     return parent, has_child.astype(bool)
+
+
+def reaches_root(parent: jnp.ndarray) -> jnp.ndarray:
+    """[n] bool: the parent chain terminates at a root (within depth
+    any depth). Malformed cyclic subgraphs (e.g. a span pair parenting
+    each other through a shared-id join) never terminate — the host tree
+    builder leaves them unreachable from the synthetic root, so its
+    traversal never emits their links; this mask is the device analog
+    (found by the linker fuzz)."""
+    n = parent.shape[0]
+    sent = n
+    ptr = jnp.concatenate(
+        [jnp.where(parent >= 0, parent, sent), jnp.full((1,), sent, parent.dtype)]
+    )
+    for _ in range(_doubling_passes(n)):
+        ptr = ptr[ptr]
+    return ptr[:n] == sent
 
 
 def nearest_rpc_ancestor(
@@ -157,7 +229,7 @@ def nearest_rpc_ancestor(
     # jump[i] = i if span i has a kind, else its parent (toward the root)
     jump = jnp.where(kind_ext != 0, jnp.arange(n + 1), par_ext)
     jump = jump.at[sent].set(sent)
-    for _ in range(ITERS):
+    for _ in range(_doubling_passes(n)):
         jump = jump[jump]
 
     anc = jump[par]  # start the walk at the parent (strict ancestor)
@@ -167,19 +239,33 @@ def nearest_rpc_ancestor(
     return anc
 
 
-def link_edges(x: LinkInput, emit: jnp.ndarray = None):
-    """Per-lane link-rule evaluation shared by the flat and bucketed
-    scatters: returns (par_svc, child_svc, main_ok, main_err, anc_svc,
-    local, back_ok).
+class LinkContext(NamedTuple):
+    """Window-INDEPENDENT link evaluation of a span window: everything
+    expensive (the parent join sort, pointer-doubling ancestors,
+    reachability) distilled to per-lane edge candidates. Cache one per
+    state version and apply any number of cheap windowed emits against
+    it (zipkin_tpu.parallel.sharded caches it per write_version — the
+    dependency query then costs an elementwise mask + scatter, not a
+    re-sort of the ring)."""
 
-    ``emit`` restricts which spans may EMIT edges; parent/ancestor joins
-    always run over every ``x.valid`` lane, so a windowed query still
-    resolves tree context from outside the window — matching the
-    reference's whole-trace linking (InMemory getDependencies links full
-    traces whose span timestamps intersect the window, SURVEY.md §3.5).
+    par_svc: jnp.ndarray  # i32 — main edge parent service (post rule 6)
+    child_svc: jnp.ndarray  # i32 — main edge child service
+    ok: jnp.ndarray  # bool — main edge passes every non-window rule
+    err: jnp.ndarray  # bool — ok and the span carries an error tag
+    anc_svc: jnp.ndarray  # i32 — nearest RPC ancestor service
+    local: jnp.ndarray  # i32 — local service (rule 6b child)
+    back: jnp.ndarray  # bool — rule 6b backfill passes non-window rules
+
+
+def link_context(x: LinkInput) -> LinkContext:
+    """Evaluate all link rules except the time window.
+
+    Parent/ancestor joins run over every ``x.valid`` lane, so a windowed
+    query still resolves tree context from outside the window — matching
+    the reference's whole-trace linking (InMemory getDependencies links
+    full traces whose span timestamps intersect the window, SURVEY.md
+    §3.5).
     """
-    if emit is None:
-        emit = x.valid
     parent, has_child = resolve_parents(x)
     anc = nearest_rpc_ancestor(parent, jnp.where(x.valid, x.kind, 0))
     anc_svc = jnp.where(anc >= 0, x.svc[jnp.where(anc >= 0, anc, 0)], 0)
@@ -187,8 +273,10 @@ def link_edges(x: LinkInput, emit: jnp.ndarray = None):
     local, remote = x.svc, x.rsvc
     kind = x.kind
 
-    # rule 1: client span with children defers to its server half
-    live = emit & x.valid & ~((kind == KIND_CLIENT) & has_child)
+    # rule 1: client span with children defers to its server half;
+    # spans in parent cycles never emit (host-traversal reachability)
+    live = x.valid & reaches_root(parent)
+    live = live & ~((kind == KIND_CLIENT) & has_child)
     # rule 2: kindless spans with both sides known act like clients
     keff = jnp.where(
         (kind == KIND_NONE) & (local > 0) & (remote > 0), KIND_CLIENT, kind
@@ -212,7 +300,6 @@ def link_edges(x: LinkInput, emit: jnp.ndarray = None):
     par_svc = jnp.where(use_anc, anc_svc, par_svc)
 
     main_ok = live & (par_svc > 0) & (child_svc > 0)
-    main_err = main_ok & x.err
 
     # rule 6b: client whose service differs from its RPC ancestor implies an
     # uninstrumented hop — backfill ancestor->client (never an error)
@@ -223,7 +310,40 @@ def link_edges(x: LinkInput, emit: jnp.ndarray = None):
         & (anc_svc > 0)
         & (anc_svc != local)
     )
-    return par_svc, child_svc, main_ok, main_err, anc_svc, local, back_ok
+    return LinkContext(
+        par_svc=par_svc, child_svc=child_svc, ok=main_ok,
+        err=main_ok & x.err, anc_svc=anc_svc, local=local, back=back_ok,
+    )
+
+
+def link_edges(x: LinkInput, emit: jnp.ndarray = None):
+    """Per-lane link-rule evaluation with an emit mask applied: returns
+    (par_svc, child_svc, main_ok, main_err, anc_svc, local, back_ok)."""
+    if emit is None:
+        emit = x.valid
+    ctx = link_context(x)
+    return (
+        ctx.par_svc, ctx.child_svc, ctx.ok & emit, ctx.err & emit,
+        ctx.anc_svc, ctx.local, ctx.back & emit,
+    )
+
+
+def emit_links(
+    ctx: LinkContext, emit: jnp.ndarray, num_services: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a context's edges for the lanes in ``emit`` — the cheap
+    half of a windowed dependency query (no sorts, no joins)."""
+    s = num_services
+    calls = jnp.zeros((s, s), jnp.uint32)
+    errors = jnp.zeros((s, s), jnp.uint32)
+    pc = jnp.clip(ctx.par_svc, 0, s - 1)
+    cc = jnp.clip(ctx.child_svc, 0, s - 1)
+    calls = calls.at[pc, cc].add((ctx.ok & emit).astype(jnp.uint32))
+    errors = errors.at[pc, cc].add((ctx.err & emit).astype(jnp.uint32))
+    bc = jnp.clip(ctx.anc_svc, 0, s - 1)
+    lc = jnp.clip(ctx.local, 0, s - 1)
+    calls = calls.at[bc, lc].add((ctx.back & emit).astype(jnp.uint32))
+    return calls, errors
 
 
 def link_window(
@@ -235,20 +355,9 @@ def link_window(
     matrices indexed by interned service id (0 = unknown; row/col 0 is
     never emitted). Merge across shards/windows by addition (psum).
     """
-    par_svc, child_svc, main_ok, main_err, anc_svc, local, back_ok = link_edges(
-        x, emit
-    )
-    s = num_services
-    calls = jnp.zeros((s, s), jnp.uint32)
-    errors = jnp.zeros((s, s), jnp.uint32)
-    pc = jnp.clip(par_svc, 0, s - 1)
-    cc = jnp.clip(child_svc, 0, s - 1)
-    calls = calls.at[pc, cc].add(main_ok.astype(jnp.uint32))
-    errors = errors.at[pc, cc].add(main_err.astype(jnp.uint32))
-    bc = jnp.clip(anc_svc, 0, s - 1)
-    lc = jnp.clip(local, 0, s - 1)
-    calls = calls.at[bc, lc].add(back_ok.astype(jnp.uint32))
-    return calls, errors
+    if emit is None:
+        emit = x.valid
+    return emit_links(link_context(x), emit, num_services)
 
 
 def link_window_bucketed(

@@ -99,16 +99,31 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         donate_argnums=(0,),
     )
 
-    def spmd_links(state: AggState, ts_lo, ts_hi):
+    def spmd_link_ctx(state: AggState):
+        """The expensive, window-independent half of a dependency query
+        (ring sort + ancestor walks), cached per state version."""
         s = jax.tree_util.tree_map(lambda a: a[0], state)
-        calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi)
+        ctx = dlink.link_context(ing.ring_link_input(s))
+        return jax.tree_util.tree_map(lambda a: a[None], ctx)
+
+    link_ctx = jax.jit(
+        shard_map(
+            spmd_link_ctx, mesh=mesh,
+            in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS),
+        )
+    )
+
+    def spmd_links(ctx, state: AggState, ts_lo, ts_hi):
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        c = jax.tree_util.tree_map(lambda a: a[0], ctx)
+        calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi, ctx=c)
         return jax.lax.psum(calls, SHARD_AXIS), jax.lax.psum(errors, SHARD_AXIS)
 
     links = jax.jit(
         shard_map(
             spmd_links,
             mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(), P()),
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
             out_specs=P(),
         )
     )
@@ -243,9 +258,10 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     # vectors over the tunnel instead of two dense matrices
     num_edges = min(4096, config.max_services * config.max_services)
 
-    def spmd_edges(state: AggState, ts_lo, ts_hi):
+    def spmd_edges(ctx, state: AggState, ts_lo, ts_hi):
         s = jax.tree_util.tree_map(lambda a: a[0], state)
-        calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi)
+        c = jax.tree_util.tree_map(lambda a: a[0], ctx)
+        calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi, ctx=c)
         calls = jax.lax.psum(calls, SHARD_AXIS).reshape(-1)
         errors = jax.lax.psum(errors, SHARD_AXIS).reshape(-1)
         top, idx = jax.lax.top_k(calls, num_edges)
@@ -254,7 +270,7 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     edges = jax.jit(
         shard_map(
             spmd_edges, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(),
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()), out_specs=P(),
         )
     )
     def spmd_card(state: AggState):
@@ -269,7 +285,7 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     )
     return (
         init, step, links, merge, flush, rollup, whist, digest_read, edges,
-        quant_digest, quant_hist, quant_whist, card, sharding,
+        quant_digest, quant_hist, quant_whist, card, link_ctx, sharding,
     )
 
 
@@ -288,8 +304,11 @@ class ShardedAggregator:
             init, self._step, self._links, self._merge, self._flush,
             self._rollup, self._whist, self._digest_read, self._edges,
             self._quant_digest, self._quant_hist, self._quant_whist,
-            self._card, self._sharding,
+            self._card, self._link_ctx, self._sharding,
         ) = _compiled_programs(config, mesh)
+        # device-resident LinkContext for the current write_version (the
+        # sorted/joined half of dependency queries, reused across windows)
+        self._ctx_cache = (-1, None)
         self.state: AggState = init()
         # Exact host-side counters: the device counters are u32 and wrap
         # after ~4.3B spans (~72 min at the north-star rate); these are the
@@ -364,12 +383,20 @@ class ShardedAggregator:
             hist, hll_regs, counters = self._merge(self.state)
             return np.asarray(hist), np.asarray(hll_regs), np.asarray(counters)
 
+    def _link_context_cached(self):
+        """Device LinkContext for the current state (callers hold lock)."""
+        version = self.write_version
+        if self._ctx_cache[0] != version:
+            self._ctx_cache = (version, self._link_ctx(self.state))
+        return self._ctx_cache[1]
+
     def dependency_matrices(
         self, ts_lo_min: int, ts_hi_min: int
     ) -> Tuple[np.ndarray, np.ndarray]:
         with self.lock:
             calls, errors = self._links(
-                self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
+                self._link_context_cached(), self.state,
+                jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min),
             )
             return np.asarray(calls), np.asarray(errors)
 
@@ -392,7 +419,8 @@ class ShardedAggregator:
         so a dependency query pulls ~KBs, not two dense [S, S] matrices."""
         with self.lock:
             idx, calls, errors = self._edges(
-                self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
+                self._link_context_cached(), self.state,
+                jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min),
             )
             return np.asarray(idx), np.asarray(calls), np.asarray(errors)
 
@@ -411,6 +439,11 @@ class ShardedAggregator:
             self.state = self._rollup(self.state)
             self._lanes_since_rollup = 0
             self.write_version += 1
+
+    def flush_now(self) -> None:
+        """Public digest flush (compile warm-up, shutdown, tests)."""
+        with self.lock:
+            self._flush_now()
 
     def windowed_histograms(self, ts_lo_min: int, ts_hi_min: int) -> np.ndarray:
         """[K, BUCKETS] histogram over the window, merged across shards

@@ -255,16 +255,24 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
 
 
 def dependency_links(
-    config: AggConfig, state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray
+    config: AggConfig,
+    state: AggState,
+    ts_lo: jnp.ndarray,
+    ts_hi: jnp.ndarray,
+    ctx: linker.LinkContext = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(calls, errors) [S, S] u32 over [ts_lo, ts_hi] epoch minutes —
     live-ring links merged with the rolled-up buckets in the window (the
     reference's "merge days: sum callCount/errorCount", SURVEY.md §3.5).
+
+    Pass a precomputed ``ctx`` (see linker.link_context) to skip the
+    ring-sort half — the aggregator caches one per state version.
     """
+    if ctx is None:
+        ctx = linker.link_context(ring_link_input(state))
     in_window = (state.r_ts_min >= ts_lo) & (state.r_ts_min <= ts_hi)
-    calls, errors = linker.link_window(
-        ring_link_input(state), config.max_services,
-        emit=state.r_valid & ~state.r_rolled & in_window,
+    calls, errors = linker.emit_links(
+        ctx, state.r_valid & ~state.r_rolled & in_window, config.max_services
     )
     bm = config.bucket_minutes
     lo_b = (ts_lo // jnp.uint32(bm)).astype(jnp.int32)
