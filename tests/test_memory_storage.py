@@ -28,6 +28,29 @@ class TestInMemoryStorage(StorageContract):
         assert storage.span_store().get_trace("5").execute() != []
         assert storage.span_store().get_trace("1").execute() == []
 
+    def test_late_earlier_span_rekeys_trace_for_eviction(self):
+        """A trace whose LATER-arriving span carries an EARLIER timestamp
+        must age by that earlier timestamp (the reference indexes every
+        accepted span as a (timestamp, traceId) eviction pair), so it is
+        evicted before traces that are wholly newer."""
+        storage = InMemoryStorage(max_span_count=4)
+        mk = lambda tid, sid, ts: Span.create(
+            tid, sid, name="op", timestamp=ts, duration=1,
+            local_endpoint=FRONTEND,
+        )
+        # trace a arrives first with a NEW timestamp...
+        storage.span_consumer().accept([mk("a", "1", TODAY_US + 9_000_000)]).execute()
+        storage.span_consumer().accept([mk("b", "1", TODAY_US + 1_000_000)]).execute()
+        # ...then a late span of trace a with a much OLDER timestamp
+        storage.span_consumer().accept([mk("a", "2", TODAY_US)]).execute()
+        # overflow by two: trace a (min ts = TODAY) must go, b must stay
+        storage.span_consumer().accept(
+            [mk("c", "1", TODAY_US + 8_000_000), mk("c", "2", TODAY_US + 8_000_001)]
+        ).execute()
+        assert storage.span_store().get_trace("a").execute() == []
+        assert storage.span_store().get_trace("b").execute() != []
+        assert storage.span_store().get_trace("c").execute() != []
+
     def test_clear(self):
         storage = InMemoryStorage()
         storage.span_consumer().accept(

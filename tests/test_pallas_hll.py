@@ -1,0 +1,45 @@
+"""Pallas HLL kernel parity vs the XLA reference (interpret mode on the
+CPU CI mesh; the real-chip timing comparison lives in
+benchmarks/pallas_bench.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.ops import hll, pallas_hll
+
+
+@pytest.mark.parametrize("rows_n,precision,n", [
+    (33, 8, 1000),     # unaligned rows, batch not a CHUNK multiple
+    (64, 9, 2048),     # aligned rows, exact chunk
+    (7, 8, 100),       # tiny everything
+    (9, 6, 200),       # m=64 < 128 lanes: column padding path
+])
+def test_kernel_matches_xla_update(rows_n, precision, n):
+    rng = np.random.default_rng(42)
+    regs = hll.new_registers(rows_n, precision)
+    # several sequential batches: state threads through
+    for seed in range(3):
+        rng2 = np.random.default_rng(seed)
+        rows = rng2.integers(0, rows_n, n, dtype=np.int32)
+        hashes = rng2.integers(0, 2**32, n, dtype=np.uint32)
+        valid = rng2.random(n) < 0.9
+        regs = pallas_hll.update(regs, rows, hashes, valid, interpret=True)
+    want = hll.new_registers(rows_n, precision)
+    for seed in range(3):
+        rng2 = np.random.default_rng(seed)
+        rows = rng2.integers(0, rows_n, n, dtype=np.int32)
+        hashes = rng2.integers(0, 2**32, n, dtype=np.uint32)
+        valid = rng2.random(n) < 0.9
+        want = hll.update(want, rows, hashes, valid)
+    assert (np.asarray(regs) == np.asarray(want)).all()
+
+
+def test_invalid_lanes_are_inert():
+    regs = hll.new_registers(16, 8)
+    rows = np.zeros(64, np.int32)
+    hashes = np.full(64, 0xDEADBEEF, np.uint32)
+    valid = np.zeros(64, bool)
+    out = pallas_hll.update(regs, rows, hashes, valid, interpret=True)
+    assert int(np.asarray(out).sum()) == 0

@@ -51,6 +51,7 @@ class InMemoryStorage(
         self._lock = threading.Lock()
         self._spans_by_trace: Dict[str, List[Span]] = {}
         self._age_heap: List[Tuple[int, str]] = []
+        self._min_ts: Dict[str, int] = {}
         self._span_count = 0
         self._closed = False
 
@@ -80,6 +81,7 @@ class InMemoryStorage(
         with self._lock:
             self._spans_by_trace.clear()
             self._age_heap.clear()
+            self._min_ts.clear()
             self._span_count = 0
 
     # -- write path --------------------------------------------------------
@@ -89,12 +91,20 @@ class InMemoryStorage(
             with self._lock:
                 for span in spans:
                     key = trace_id_key(span.trace_id, self.strict_trace_id)
+                    ts = span.timestamp_as_long()
                     bucket = self._spans_by_trace.get(key)
                     if bucket is None:
                         bucket = self._spans_by_trace[key] = []
-                        heapq.heappush(
-                            self._age_heap, (span.timestamp_as_long(), key)
-                        )
+                    # Eviction key is the trace's MIN span timestamp,
+                    # updated continuously: the reference indexes every
+                    # accepted span as a (timestamp, traceId) pair, so a
+                    # late span with an earlier timestamp makes its trace
+                    # MORE evictable. Stale heap entries are skipped
+                    # lazily on pop.
+                    cur = self._min_ts.get(key)
+                    if cur is None or ts < cur:
+                        self._min_ts[key] = ts
+                        heapq.heappush(self._age_heap, (ts, key))
                     bucket.append(span)
                     self._span_count += 1
                 self._evict_locked()
@@ -104,12 +114,15 @@ class InMemoryStorage(
     def _evict_locked(self) -> None:
         """Drop whole traces, oldest first, until under the bound.
 
-        Amortized O(evicted log T): the heap is keyed by each trace's first
-        seen timestamp; entries for already-evicted traces are skipped lazily.
+        Amortized O(evicted log T): entries for already-evicted traces or
+        superseded (stale) timestamps are skipped lazily.
         """
         while self._span_count > self.max_span_count and self._age_heap:
-            _, key = heapq.heappop(self._age_heap)
+            ts, key = heapq.heappop(self._age_heap)
+            if self._min_ts.get(key) != ts:
+                continue  # stale entry: trace gone or re-keyed older
             spans = self._spans_by_trace.pop(key, None)
+            del self._min_ts[key]
             if spans is not None:
                 self._span_count -= len(spans)
 

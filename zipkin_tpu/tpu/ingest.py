@@ -11,6 +11,7 @@ padding). Reads are pure functions over the same state.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -28,6 +29,22 @@ from zipkin_tpu.tpu.state import (
 )
 
 
+def _hll_update(registers, rows, hashes, valid):
+    """HLL update with the opt-in Pallas backend (TPU_PALLAS_HLL=1).
+
+    Measured ~11% faster than the XLA scatter on a v5e chip but <1% of
+    the ingest step — see ops/pallas_hll.py for the evidence and why the
+    XLA path stays the default."""
+    if (
+        os.environ.get("TPU_PALLAS_HLL", "") in ("1", "true")
+        and jax.default_backend() == "tpu"
+    ):
+        from zipkin_tpu.ops import pallas_hll
+
+        return pallas_hll.update(registers, rows, hashes, valid)
+    return hll.update(registers, rows, hashes, valid)
+
+
 def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggState:
     """Fold one columnar batch into the aggregate state (pure, jit-safe).
 
@@ -39,8 +56,8 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     # --- HLL: distinct traces per service + globally --------------------
     h = hashing.fmix32(batch.trace_h)
     svc_rows = jnp.clip(batch.svc, 0, config.max_services - 1)
-    new_hll = hll.update(state.hll, svc_rows, h, valid & (batch.svc > 0))
-    new_hll = hll.update(
+    new_hll = _hll_update(state.hll, svc_rows, h, valid & (batch.svc > 0))
+    new_hll = _hll_update(
         new_hll, jnp.full((n,), config.global_hll_row, jnp.int32), h, valid
     )
 

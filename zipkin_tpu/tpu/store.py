@@ -295,6 +295,12 @@ class TpuStorage(
                 self._read_cache[key] = value
         return value
 
+    def invalidate_read_cache(self) -> None:
+        """Drop memoized device pulls (keeps the aggregator's link
+        context). For harnesses that must re-measure device reads."""
+        with self._read_cache_lock:
+            self._read_cache.clear()
+
     def get_dependencies(self, end_ts: int, lookback: int) -> Call[List[DependencyLink]]:
         def run() -> List[DependencyLink]:
             lo_min = epoch_minutes(end_ts - lookback)
