@@ -45,7 +45,7 @@ def main() -> None:
     # the wall budget runs out. Every reported pass is still a real
     # sustained end-to-end measurement.
     good_floor = float(os.environ.get("BENCH_GOOD_FLOOR", BASELINE_PER_CHIP))
-    max_wall_s = float(os.environ.get("BENCH_MAX_WALL_S", 1200.0))
+    max_wall_s = float(os.environ.get("BENCH_MAX_WALL_S", 600.0))
     degraded_gap_s = float(os.environ.get("BENCH_DEGRADED_GAP_S", 45.0))
     pass_abort_s = float(os.environ.get("BENCH_PASS_ABORT_S", 30.0))
     # Hard cap on total passes: without it the stopping rule is

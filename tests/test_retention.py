@@ -140,6 +140,18 @@ class TestWindowedPercentiles:
         rows = store.latency_quantiles([0.5], use_digest=False)
         assert rows[0]["count"] == 400
 
+    def test_digest_quantiles_agree_flushed_and_pending(self, loaded):
+        """The host picks the no-pending-fold program after a flush; both
+        variants must answer identically."""
+        store, _, _ = loaded
+        with_pend = store.latency_quantiles([0.5, 0.99])
+        assert store.agg._pend_lanes > 0  # exercised the pending variant
+        store.agg.flush_now()
+        store.invalidate_read_cache()
+        assert store.agg._pend_lanes == 0  # exercises the nopend variant
+        flushed = store.latency_quantiles([0.5, 0.99])
+        assert with_pend == flushed
+
     def test_window_before_retention_is_empty(self, loaded):
         store, hour0, _ = loaded
         # a window 100 days before any data: no rows
