@@ -1,0 +1,120 @@
+"""AsyncIngestFeeder: pipelined fast ingest lands every span with the
+same aggregate results as the synchronous path."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.fixtures import TRACE, lots_of_spans
+from zipkin_tpu import native
+from zipkin_tpu.collector.core import CollectorSampler
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.parallel.mesh import make_mesh
+from zipkin_tpu.tpu.feeder import AsyncIngestFeeder
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+SMALL = AggConfig(
+    max_services=64, max_keys=256, hll_precision=8, digest_centroids=16,
+    digest_buffer=4096, ring_capacity=4096, link_buckets=4, hist_slices=2,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native codec unavailable"
+)
+
+
+def make_store():
+    return TpuStorage(
+        config=SMALL, mesh=make_mesh(1), pad_to_multiple=256,
+        fast_archive_sample=1,
+    )
+
+
+def test_feeder_matches_synchronous_path():
+    spans = lots_of_spans(3000, seed=21, services=5, span_names=8)
+    payloads = [
+        json_v2.encode_span_list(spans[i : i + 500])
+        for i in range(0, len(spans), 500)
+    ]
+
+    sync_store = make_store()
+    for p in payloads:
+        sync_store.ingest_json_fast(p)
+    sync_store.agg.block_until_ready()
+
+    async_store = make_store()
+    with AsyncIngestFeeder(async_store, depth=3) as feeder:
+        for p in payloads:
+            feeder.submit(p)
+    assert feeder._accepted == len(spans)
+
+    assert (
+        async_store.ingest_counters()["spans"]
+        == sync_store.ingest_counters()["spans"]
+        == len(spans)
+    )
+    a = async_store.latency_quantiles([0.5, 0.99], use_digest=False)
+    b = sync_store.latency_quantiles([0.5, 0.99], use_digest=False)
+    assert a == b
+    # dependency links identical (batch order does not matter)
+    end_ts = max(s.timestamp for s in spans if s.timestamp) // 1000 + 3_600_000
+    la = sorted((l.parent, l.child, l.call_count)
+                for l in async_store.get_dependencies(end_ts, 10**15).execute())
+    lb = sorted((l.parent, l.child, l.call_count)
+                for l in sync_store.get_dependencies(end_ts, 10**15).execute())
+    assert la == lb
+    # archive sample (1-in-1) landed too
+    assert async_store.get_trace(spans[0].trace_id).execute() != []
+
+
+def test_feeder_applies_sampler():
+    spans = lots_of_spans(2000, seed=5, services=4, span_names=4)
+    payload = json_v2.encode_span_list(spans)
+    store = make_store()
+    with AsyncIngestFeeder(store, sampler=CollectorSampler(0.3)) as feeder:
+        feeder.submit(payload)
+    total = feeder._accepted + feeder._dropped
+    assert total == len(spans)
+    assert 0 < feeder._accepted < len(spans)
+
+
+def test_fallback_path_applies_sampler_too():
+    """The object-path fallback must sample like the collector would —
+    otherwise a payload with escaped strings ingests at 100% while the
+    fast path samples (review finding r2)."""
+    store = make_store()
+    payload = json_v2.encode_span_list(TRACE).replace(b"get /", b"get \\u002f")
+    with AsyncIngestFeeder(store, sampler=CollectorSampler(0.0)) as feeder:
+        feeder.submit(payload)
+    assert feeder._fallback == 1
+    assert feeder._accepted == 0
+    assert feeder._dropped == len(TRACE)
+
+
+def test_error_in_dispatch_surfaces_instead_of_deadlocking():
+    store = make_store()
+    feeder = AsyncIngestFeeder(store, depth=1)
+
+    def boom(parsed, cols):
+        raise RuntimeError("device gone")
+
+    store._fast_dispatch = boom
+    payload = json_v2.encode_span_list(TRACE)
+    with pytest.raises(RuntimeError):
+        # enough submissions to fill both bounded queues past the failure
+        for _ in range(20):
+            feeder.submit(payload)
+        feeder.drain()
+
+
+def test_feeder_falls_back_for_escaped_strings():
+    # escaped span names are the fast parser's documented bail-out
+    store = make_store()
+    payload = json_v2.encode_span_list(TRACE).replace(b"get /", b"get \\u002f")
+    with AsyncIngestFeeder(store) as feeder:
+        feeder.submit(payload)
+    assert feeder._fallback == 1
+    assert feeder._accepted == len(TRACE)
+    assert store.get_trace(TRACE[0].trace_id).execute() != []

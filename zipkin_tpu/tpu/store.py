@@ -166,6 +166,20 @@ class TpuStorage(
         Returns (accepted, sample_dropped), or None when the native path
         can't take this payload (caller falls back to the object path).
         """
+        work = self._fast_parse(data, sampler)
+        if work is None:
+            return None
+        accepted, dropped, chunks = work
+        for parsed, cols in chunks:
+            self._fast_dispatch(parsed, cols)
+        return accepted, dropped
+
+    def _fast_parse(self, data: bytes, sampler=None):
+        """Host half of the fast path: native parse + intern + sample +
+        chunk + columnar pack. Returns (accepted, dropped, [(parsed,
+        cols), ...]) or None for payloads the fast parser can't take.
+        Split from :meth:`_fast_dispatch` so AsyncIngestFeeder can run
+        the two halves in separate pipeline stages."""
         from zipkin_tpu import native
         from zipkin_tpu.tpu.columnar import pack_parsed
 
@@ -179,43 +193,50 @@ class TpuStorage(
             if parsed is None:
                 return None
             self._nvocab.sync()
-        n = parsed.n
-        dropped = 0
-        if sampler is not None and sampler.rate < 1.0 and n:
-            lo = (parsed.tl1[:n].astype(np.uint64) << np.uint64(32)) | parsed.tl0[
-                :n
-            ].astype(np.uint64)
-            signed = lo.view(np.int64)
-            # numpy abs(INT64_MIN) overflows back to INT64_MIN (negative);
-            # Java parity maps MIN_VALUE -> MAX_VALUE so it drops at <1.0.
-            t = np.abs(signed)
-            t = np.where(t == np.iinfo(np.int64).min, np.iinfo(np.int64).max, t)
-            keep = (t <= sampler._boundary) | (parsed.debug[:n] != 0)
-            dropped = int(n - keep.sum())
-            if dropped:
-                idx = np.nonzero(keep)[0]
-                for field in _PARSED_FIELDS:
-                    col = getattr(parsed, field, None)
-                    if col is not None:
-                        setattr(parsed, field, col[:n][idx])
-                parsed.n = n = len(idx)
-        if n == 0:
-            return 0, dropped
-        self._archive_fast_sample(parsed, n)
-        for lo_i in range(0, n, self.max_batch):
-            hi_i = min(lo_i + self.max_batch, n)
-            if lo_i == 0 and hi_i == n:
-                sub = parsed
-            else:
-                sub = native.ParsedColumns()
-                sub.data = parsed.data
-                for f in _PARSED_FIELDS:
-                    col = getattr(parsed, f, None)
-                    setattr(sub, f, None if col is None else col[lo_i:hi_i])
-                sub.n = hi_i - lo_i
-            cols = pack_parsed(sub, self.vocab, self._pad)
-            self.agg.ingest(cols)
-        return n, dropped
+            n = parsed.n
+            dropped = 0
+            if sampler is not None and sampler.rate < 1.0 and n:
+                lo = (
+                    parsed.tl1[:n].astype(np.uint64) << np.uint64(32)
+                ) | parsed.tl0[:n].astype(np.uint64)
+                signed = lo.view(np.int64)
+                # numpy abs(INT64_MIN) overflows back to INT64_MIN
+                # (negative); Java parity maps MIN_VALUE -> MAX_VALUE so
+                # it drops at <1.0.
+                t = np.abs(signed)
+                t = np.where(
+                    t == np.iinfo(np.int64).min, np.iinfo(np.int64).max, t
+                )
+                keep = (t <= sampler._boundary) | (parsed.debug[:n] != 0)
+                dropped = int(n - keep.sum())
+                if dropped:
+                    idx = np.nonzero(keep)[0]
+                    for field in _PARSED_FIELDS:
+                        col = getattr(parsed, field, None)
+                        if col is not None:
+                            setattr(parsed, field, col[:n][idx])
+                    parsed.n = n = len(idx)
+            if n == 0:
+                return 0, dropped, []
+            chunks = []
+            for lo_i in range(0, n, self.max_batch):
+                hi_i = min(lo_i + self.max_batch, n)
+                if lo_i == 0 and hi_i == n:
+                    sub = parsed
+                else:
+                    sub = native.ParsedColumns()
+                    sub.data = parsed.data
+                    for f in _PARSED_FIELDS:
+                        col = getattr(parsed, f, None)
+                        setattr(sub, f, None if col is None else col[lo_i:hi_i])
+                    sub.n = hi_i - lo_i
+                chunks.append((sub, pack_parsed(sub, self.vocab, self._pad)))
+        return n, dropped, chunks
+
+    def _fast_dispatch(self, parsed, cols) -> None:
+        """Device half of the fast path: sampled archive + sharded ingest."""
+        self._archive_fast_sample(parsed, parsed.n)
+        self.agg.ingest(cols)
 
     def _archive_fast_sample(self, parsed, n: int) -> None:
         """Archive a trace-affine 1/N sample of a fast-ingest batch at
