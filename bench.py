@@ -86,14 +86,11 @@ def main() -> None:
             for c in chunks
         ]
         # Warmup must compile EVERY program the timed loop can hit — the
-        # step alone is not enough: the flush and rollup programs would
-        # otherwise first-compile inside the measurement (remote compiles
-        # through the tunnel take minutes and masqueraded as "degraded
-        # phases" in round 2 until this was isolated).
-        store.ingest_json_fast(payloads[0])
-        store.agg.rollup_now()
-        store.agg.flush_now()
-        store.agg.block_until_ready()
+        # step alone is not enough: the fused flush/rollup step variants
+        # would otherwise first-compile inside the measurement (remote
+        # compiles through the tunnel take minutes and masqueraded as
+        # "degraded phases" in round 2 until this was isolated).
+        store.warm(payloads[0])
 
         def one_pass() -> float:
             start = time.perf_counter()
@@ -112,10 +109,7 @@ def main() -> None:
     else:
         agg = ShardedAggregator(config, mesh=mesh)
         packed = [pack_spans(c, vocab, pad_to_multiple=batch_size) for c in chunks]
-        agg.ingest(packed[0])
-        agg.rollup_now()
-        agg.flush_now()
-        agg.block_until_ready()
+        agg.warm_programs(packed[0])
 
         def one_pass() -> float:
             start = time.perf_counter()

@@ -231,14 +231,11 @@ def config4() -> bool:
     lookback = 1000 * 86_400_000
     fast = native.available()
     if fast:
-        # warm EVERY program the stream can hit (step, flush, rollup) —
-        # first compiles through the remote-compile tunnel take minutes
-        # and must not land inside the measurement
-        store.ingest_json_fast(payloads[0])
-        store.agg.rollup_now()
-        store.agg.flush_now()
-        store.agg.block_until_ready()
-        sent = batch
+        # warm EVERY program the stream can hit (all fused step variants
+        # + flush + rollup) — first compiles through the remote-compile
+        # tunnel take minutes and must not land inside the measurement
+        store.warm(payloads[0])
+        sent = store.ingest_counters()["spans"]
     else:  # pragma: no cover - no C toolchain
         sent = 0
 

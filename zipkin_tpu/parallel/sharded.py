@@ -84,20 +84,38 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
 
     one = functools.partial(ing.ingest_step, config)
 
-    def spmd_step(state: AggState, fused: jnp.ndarray) -> AggState:
-        squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
-        expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
-        return expand(one(squeeze(state), unfuse_columns(fused[0])))
+    def _make_step(pre_flush: bool, pre_rollup: bool):
+        """Step program variants with the periodic maintenance programs
+        FUSED in front: when the host decides a flush and/or rollup is
+        due, dispatching one combined program instead of two or three
+        saves the tunnel's fixed per-dispatch round trip (~23ms each —
+        ~10% of a steady-state batch when both fire)."""
 
-    step = jax.jit(
-        shard_map(
-            spmd_step,
-            mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-            out_specs=P(SHARD_AXIS),
-        ),
-        donate_argnums=(0,),
-    )
+        def spmd(state: AggState, fused: jnp.ndarray) -> AggState:
+            squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+            s = squeeze(state)
+            if pre_flush:
+                s = ing.flush_digest(config, s)
+            if pre_rollup:
+                s = ing.rollup_step(config, s)
+            return expand(one(s, unfuse_columns(fused[0])))
+
+        return jax.jit(
+            shard_map(
+                spmd,
+                mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                out_specs=P(SHARD_AXIS),
+            ),
+            donate_argnums=(0,),
+        )
+
+    step_variants = {
+        (flush, rollup): _make_step(flush, rollup)
+        for flush in (False, True)
+        for rollup in (False, True)
+    }
 
     def spmd_link_ctx(state: AggState):
         """The expensive, window-independent half of a dependency query
@@ -310,9 +328,9 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         shard_map(spmd_card, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
     )
     return (
-        init, step, links, merge, flush, rollup, whist, digest_read, edges,
-        quant_digest, quant_digest_nopend, quant_hist, quant_whist, card,
-        link_ctx, sharding,
+        init, step_variants, links, merge, flush, rollup, whist, digest_read,
+        edges, quant_digest, quant_digest_nopend, quant_hist, quant_whist,
+        card, link_ctx, sharding,
     )
 
 
@@ -328,11 +346,12 @@ class ShardedAggregator:
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape))
         (
-            init, self._step, self._links, self._merge, self._flush,
+            init, self._step_variants, self._links, self._merge, self._flush,
             self._rollup, self._whist, self._digest_read, self._edges,
             self._quant_digest, self._quant_digest_nopend, self._quant_hist,
             self._quant_whist, self._card, self._link_ctx, self._sharding,
         ) = _compiled_programs(config, mesh)
+        self._step = self._step_variants[(False, False)]
         # device-resident LinkContext for the current write_version (the
         # sorted/joined half of dependency queries, reused across windows)
         self._ctx_cache = (-1, None)
@@ -388,11 +407,18 @@ class ShardedAggregator:
             )
         device_batch = jax.device_put(fused, self._sharding)
         with self.lock:
-            if self._pend_lanes + lanes > self.config.digest_buffer:
-                self._flush_now()
-            if self._lanes_since_rollup + lanes > self.config.rollup_segment:
-                self.rollup_now()
-            self.state = self._step(self.state, device_batch)
+            # fold due maintenance into ONE fused dispatch with the step
+            need_flush = self._pend_lanes + lanes > self.config.digest_buffer
+            need_rollup = (
+                self._lanes_since_rollup + lanes > self.config.rollup_segment
+            )
+            self.state = self._step_variants[(need_flush, need_rollup)](
+                self.state, device_batch
+            )
+            if need_flush:
+                self._pend_lanes = 0
+            if need_rollup:
+                self._lanes_since_rollup = 0
             self._pend_lanes += lanes
             self._lanes_since_rollup += lanes
             self.write_version += 1
@@ -458,6 +484,30 @@ class ShardedAggregator:
         self.state = self._flush(self.state)
         self._pend_lanes = 0
         self.write_version += 1
+
+    def warm_programs(self, cols: SpanColumns) -> None:
+        """Compile every program the steady-state ingest loop can
+        dispatch (all fused step variants that can occur for this batch
+        size, plus the standalone flush/rollup) by running them on a real
+        batch. First compiles through a remote-compile tunnel take
+        minutes and must never land inside a timed or serving window.
+        Ingests ``cols`` several times — call before real traffic."""
+        for force_flush, force_rollup in (
+            (False, False), (True, False), (False, True), (True, True)
+        ):
+            with self.lock:
+                if force_flush:
+                    self._pend_lanes = self.config.digest_buffer
+                if force_rollup:
+                    self._lanes_since_rollup = self.config.rollup_segment
+            # ingest() picks the variant from the (possibly forced)
+            # counters; when a non-forced combination cannot occur at
+            # this batch size, ingest lawfully dispatches the variant
+            # that WOULD run in production instead — also fine to warm.
+            self.ingest(cols)
+        self.rollup_now()
+        self.flush_now()
+        self.block_until_ready()
 
     def rollup_now(self) -> None:
         """Run the link-rollup program (rollup_step) and reset the

@@ -238,6 +238,30 @@ class TpuStorage(
         self._archive_fast_sample(parsed, parsed.n)
         self.agg.ingest(cols)
 
+    def warm(self, data: bytes) -> None:
+        """Compile every ingest-path program against a real payload (the
+        sample is INGESTED repeatedly — serving/benchmark warm-up only).
+        Remote compiles take minutes and must precede any timed window."""
+        work = self._fast_parse(data)
+        if work is None:
+            # payload the fast parser can't take: warm through the object
+            # path instead — this still must reach agg.warm_programs or
+            # the fused/flush/rollup programs first-compile mid-traffic
+            from zipkin_tpu.model import codec
+            from zipkin_tpu.tpu.columnar import pack_spans
+
+            spans = codec.decode_spans(data)
+            self._archive.accept(spans).execute()
+            with self._intern_lock:
+                cols = pack_spans(
+                    spans[: self.max_batch], self.vocab, self._pad
+                )
+            self.agg.warm_programs(cols)
+            return
+        _, _, chunks = work
+        if chunks:
+            self.agg.warm_programs(chunks[0][1])
+
     def _archive_fast_sample(self, parsed, n: int) -> None:
         """Archive a trace-affine 1/N sample of a fast-ingest batch at
         full fidelity by re-decoding each sampled span's exact JSON slice
