@@ -140,3 +140,70 @@ class TestNativeTruncatedNull:
         base = b'[{"traceId":"000000000000000a","id":"000000000000000b","localEndpoint":n'
         # parser must fail cleanly (None -> python fallback), not read OOB
         assert native.parse_spans(base) is None
+
+
+class TestQuantileWindowValidation:
+    def test_half_open_window_raises(self):
+        # ADVICE r2: ts_lo_min without ts_hi_min crashed with a TypeError
+        # deep in jnp.uint32(None); the public signature now validates.
+        from zipkin_tpu.parallel.mesh import make_mesh
+        from zipkin_tpu.parallel.sharded import ShardedAggregator
+        from zipkin_tpu.tpu.state import AggConfig
+
+        cfg = AggConfig(
+            max_services=8, max_keys=16, hll_precision=6, digest_centroids=8,
+            digest_buffer=256, ring_capacity=128, link_buckets=2,
+            hist_slices=2,
+        )
+        agg = ShardedAggregator(cfg, mesh=make_mesh(1))
+        with pytest.raises(ValueError, match="together"):
+            agg.quantiles([0.5], ts_lo_min=10)
+        with pytest.raises(ValueError, match="together"):
+            agg.quantiles([0.5], ts_hi_min=10)
+
+
+class TestSnapshotVersioning:
+    def test_version_mismatch_distinct_from_config_change(self, tmp_path, caplog):
+        import json
+        import logging
+        import os
+
+        from zipkin_tpu.parallel.mesh import make_mesh
+        from zipkin_tpu.tpu import snapshot
+        from zipkin_tpu.tpu.state import AggConfig
+        from zipkin_tpu.tpu.store import TpuStorage
+
+        cfg = AggConfig(
+            max_services=8, max_keys=16, hll_precision=6, digest_centroids=8,
+            digest_buffer=256, ring_capacity=128, link_buckets=2,
+            hist_slices=2,
+        )
+        store = TpuStorage(config=cfg, mesh=make_mesh(1), pad_to_multiple=64)
+        d = str(tmp_path / "snap")
+        snapshot.save(store, d)
+
+        meta_path = os.path.join(d, snapshot.META_FILE)
+        meta = json.load(open(meta_path))
+        assert meta["version"] == snapshot.SNAPSHOT_VERSION
+
+        # stale format version -> distinct message, restore refused
+        meta["version"] = snapshot.SNAPSHOT_VERSION - 1
+        json.dump(meta, open(meta_path, "w"))
+        with caplog.at_level(logging.WARNING):
+            assert not snapshot.maybe_restore(store, d)
+        assert "format version" in caplog.text
+
+        # operator config change -> its own message
+        caplog.clear()
+        meta["version"] = snapshot.SNAPSHOT_VERSION
+        meta["config"] = dict(meta["config"], max_keys=999)
+        json.dump(meta, open(meta_path, "w"))
+        with caplog.at_level(logging.WARNING):
+            assert not snapshot.maybe_restore(store, d)
+        assert "config changed" in caplog.text
+
+        # intact snapshot restores
+        meta["config"] = json.loads(json.dumps(
+            __import__("dataclasses").asdict(store.config)))
+        json.dump(meta, open(meta_path, "w"))
+        assert snapshot.maybe_restore(store, d)

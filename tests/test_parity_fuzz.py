@@ -227,6 +227,68 @@ def _random_forest(rng: random.Random, n_traces: int):
     return spans
 
 
+def test_linker_ring_wrap_duplicate_id_tiebreak():
+    """After the ring wraps, lane index no longer tracks insertion order;
+    first-wins tie-breaks between duplicate-id parent candidates must use
+    true insertion age (ADVICE r2, ops/linker.py LinkInput.seq).
+
+    Construction: candidate parent A is inserted BEFORE candidate B (same
+    span id, different services), but filler spans wrap the cursor so B
+    lands on a LOWER lane than A. The host picks A (first in insertion
+    order); a lane-index tie-break would pick B.
+    """
+    from zipkin_tpu.internal.dependency_linker import DependencyLinker
+
+    cfg = AggConfig(
+        max_services=32, max_keys=64, hll_precision=8, digest_centroids=16,
+        digest_buffer=2048, ring_capacity=256, link_buckets=8,
+        bucket_minutes=60, hist_slices=2,
+    )
+    store = TpuStorage(config=cfg, mesh=make_mesh(1), pad_to_multiple=64)
+
+    def filler(i):
+        return Span.create(
+            trace_id=f"{0xF000 + i:016x}", id=f"{0xF000 + i:016x}",
+            timestamp=TODAY_US, duration=10,
+        )
+
+    pid = f"{0xABC:016x}"
+    tid = f"{0xDEAD:016x}"
+    mk = lambda sid, svc, kind, parent=None: Span.create(
+        trace_id=tid, id=sid, parent_id=parent, kind=kind, name="op",
+        timestamp=TODAY_US, duration=10,
+        local_endpoint=Endpoint.create(svc, "10.0.0.1"),
+    )
+    # fill to lane 192, insert A there, then exactly enough filler to
+    # wrap the cursor to lane 0 — B lands on a LOWER lane than A
+    store.accept([filler(i) for i in range(192)]).execute()
+    store.accept([mk(pid, "parent-a", Kind.CLIENT)]).execute()
+    store.accept([filler(200 + i) for i in range(63)]).execute()  # wraps
+    store.accept(
+        [
+            mk(pid, "parent-b", Kind.CLIENT),
+            mk(f"{0xC1D:016x}", "child", Kind.SERVER, parent=pid),
+        ]
+    ).execute()
+
+    host = DependencyLinker()
+    host.put_trace(
+        [
+            mk(pid, "parent-a", Kind.CLIENT),
+            mk(pid, "parent-b", Kind.CLIENT),
+            mk(f"{0xC1D:016x}", "child", Kind.SERVER, parent=pid),
+        ]
+    )
+    end_ts = (TODAY_US + 600_000_000) // 1000
+    got = sorted(
+        (l.parent, l.child, l.call_count)
+        for l in store.get_dependencies(end_ts, 1000 * DAY_MS).execute()
+    )
+    want = sorted((l.parent, l.child, l.call_count) for l in host.link())
+    assert ("parent-a", "child", 1) in want  # sanity: host picks A
+    assert got == want
+
+
 @pytest.mark.parametrize("seed", [7, 99, 2026])
 def test_linker_fuzz_device_vs_host(seed):
     from zipkin_tpu.internal.dependency_linker import DependencyLinker

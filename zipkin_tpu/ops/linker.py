@@ -67,6 +67,12 @@ class LinkInput(NamedTuple):
     rsvc: jnp.ndarray  # i32 remote service id (0 = unknown)
     err: jnp.ndarray  # bool — span has an "error" tag
     valid: jnp.ndarray  # bool — lane holds a live span
+    # insertion sequence: a permutation of [0, n) where LOWER = inserted
+    # EARLIER. The host tree builder's tie-breaks are first-wins in
+    # insertion order; for a circular ring the lane index stops tracking
+    # insertion order after the first wrap, so the ring view derives age
+    # from (lane - ring_pos) % R. None (plain batch windows) = lane order.
+    seq: jnp.ndarray = None
 
 
 def _run_starts(key_lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
@@ -79,8 +85,10 @@ def _run_starts(key_lanes: Sequence[jnp.ndarray]) -> jnp.ndarray:
 def _run_min(values: jnp.ndarray, change: jnp.ndarray, none: int) -> jnp.ndarray:
     """Per-run min of ``values`` over runs delimited by ``change`` (sorted
     lanes). ``none`` is the empty sentinel (values >= none mean absent);
-    returns -1 for absent. Min = FIRST in insertion order, matching the
-    host tree builder's first-wins candidate choice."""
+    returns -1 for absent. Values are insertion-sequence ranks (see
+    LinkInput.seq), so min = FIRST in insertion order, matching the host
+    tree builder's first-wins candidate choice — even after a circular
+    ring wraps and lane index stops tracking age."""
     run_id = jnp.cumsum(change.astype(jnp.int32)) - 1
     seg = jnp.full(values.shape[0], none, values.dtype).at[run_id].min(values)
     out = seg[run_id]
@@ -138,10 +146,15 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
     svc_lane = lane(x.svc.astype(jnp.uint32), x.svc.astype(jnp.uint32))
 
     idx = jnp.arange(n, dtype=jnp.int32)
+    # candidate VALUES are insertion-sequence ranks, not lane indices —
+    # run-min then picks the first-INSERTED candidate (host first-wins)
+    # regardless of where the ring cursor has wrapped to
+    seq = idx if x.seq is None else x.seq.astype(jnp.int32)
+    rank_to_idx = jnp.zeros(n, jnp.int32).at[seq].set(idx)
     sent = 2 * n  # run-min "absent" sentinel
     far = jnp.full((n,), sent, jnp.int32)
-    val_sh = jnp.concatenate([jnp.where(sharedv, idx, sent), far])
-    val_ns = jnp.concatenate([jnp.where(nonshared, idx, sent), far])
+    val_sh = jnp.concatenate([jnp.where(sharedv, seq, sent), far])
+    val_ns = jnp.concatenate([jnp.where(nonshared, seq, sent), far])
 
     order = jnp.lexsort((svc_lane,) + tuple(id_lanes))
     coarse = _run_starts([l[order] for l in id_lanes])
@@ -154,7 +167,10 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
         _run_min(ns_sorted, coarse, sent),  # first non-shared
     ]
     inv = jnp.zeros(2 * n, jnp.int32)
-    un = [inv.at[order].set(r) for r in results]
+    to_idx = lambda r: jnp.where(
+        r >= 0, rank_to_idx[jnp.where(r >= 0, r, 0)], -1
+    )
+    un = [to_idx(inv.at[order].set(r)) for r in results]
     sh_fine, sh_any, ns_any = un
 
     # Parent-id resolution in SpanNode._choose_parent preference order:

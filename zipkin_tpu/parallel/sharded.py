@@ -540,7 +540,13 @@ class ShardedAggregator:
     ) -> Tuple[np.ndarray, np.ndarray]:
         """([K, Q] quantiles, [K] counts) computed ON device in a single
         dispatch; ``source`` is "digest" or "hist"; a (ts_lo_min,
-        ts_hi_min) window uses the time-sliced histograms."""
+        ts_hi_min) window uses the time-sliced histograms — both bounds
+        required (a half-open window has no defined slice selection)."""
+        if (ts_lo_min is None) != (ts_hi_min is None):
+            raise ValueError(
+                "ts_lo_min and ts_hi_min must be given together "
+                f"(got ts_lo_min={ts_lo_min!r}, ts_hi_min={ts_hi_min!r})"
+            )
         qarr = jnp.asarray(np.asarray(qs, np.float32))
         with self.lock:
             if ts_lo_min is not None:

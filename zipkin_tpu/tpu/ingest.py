@@ -217,12 +217,18 @@ def ring_link_input(state: AggState) -> linker.LinkInput:
     """View the retention ring as a link window (all valid lanes; use the
     ``emit`` mask of link_window/link_edges for time filtering so parent
     joins keep full-ring context)."""
+    r = state.r_valid.shape[0]
+    lane = jnp.arange(r, dtype=jnp.int32)
     return linker.LinkInput(
         trace_h=state.r_trace_h, tl0=state.r_tl0, tl1=state.r_tl1,
         s0=state.r_s0, s1=state.r_s1, p0=state.r_p0, p1=state.r_p1,
         shared=state.r_shared, kind=state.r_kind,
         svc=state.r_svc, rsvc=state.r_rsvc, err=state.r_err,
         valid=state.r_valid,
+        # age since the cursor: the cursor's own lane is the OLDEST live
+        # span (next to be overwritten), so tie-breaks stay first-wins in
+        # true insertion order across ring wraps (ADVICE r2)
+        seq=(lane - state.ring_pos) % r,
     )
 
 
@@ -241,10 +247,10 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
     ``config.rollup_segment`` (see ShardedAggregator.ingest), so no valid
     span is ever overwritten without its links being preserved.
     """
-    r = config.ring_capacity
-    lane = jnp.arange(r, dtype=jnp.int32)
-    offset = (lane - state.ring_pos) % r
-    to_roll = state.r_valid & ~state.r_rolled & (offset < config.rollup_segment)
+    x = ring_link_input(state)
+    # x.seq is age-since-cursor: the lanes the cursor will overwrite next
+    # are exactly the oldest rollup_segment ranks
+    to_roll = state.r_valid & ~state.r_rolled & (x.seq < config.rollup_segment)
 
     bm = jnp.uint32(config.bucket_minutes)
     bucket_abs = (state.r_ts_min // bm).astype(jnp.int32)
@@ -255,7 +261,7 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
     )
 
     calls_d, errs_d = linker.link_window_bucketed(
-        ring_link_input(state), config.max_services, slot, d, emit
+        x, config.max_services, slot, d, emit
     )
     rollup_calls = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_calls)
     rollup_errs = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_errs)

@@ -33,6 +33,12 @@ logger = logging.getLogger(__name__)
 STATE_FILE = "sketch_state.npz"
 META_FILE = "meta.json"
 
+# Bump whenever the AggState pytree or the config serialization changes
+# shape (ADVICE r2: v1 silently covered two incompatible layouts and
+# restore failures misattributed the cause to operator config changes).
+# v2 = r2 retention layout (hist_t/rollup leaves, retention config keys).
+SNAPSHOT_VERSION = 2
+
 
 def save(store: "TpuStorage", directory: str) -> str:
     """Snapshot sketches + vocab into ``directory`` (atomic). Returns path."""
@@ -47,7 +53,7 @@ def save(store: "TpuStorage", directory: str) -> str:
     os.replace(tmp, os.path.join(directory, STATE_FILE))
 
     meta = {
-        "version": 1,
+        "version": SNAPSHOT_VERSION,
         "saved_at": time.time(),
         "n_shards": store.agg.n_shards,
         "config": dataclasses.asdict(store.config),
@@ -71,11 +77,24 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
         return False
     with open(meta_path) as f:
         meta = json.load(f)
-    want = dataclasses.asdict(store.config)
-    if meta.get("config") != want or meta.get("n_shards") != store.agg.n_shards:
+    if meta.get("version") != SNAPSHOT_VERSION:
         logger.warning(
-            "snapshot at %s is incompatible (config/shards changed); ignoring",
-            directory,
+            "snapshot at %s has format version %s (this build writes %s); "
+            "ignoring — re-snapshot after the next ingest",
+            directory, meta.get("version"), SNAPSHOT_VERSION,
+        )
+        return False
+    want = dataclasses.asdict(store.config)
+    if meta.get("config") != want:
+        logger.warning(
+            "snapshot at %s was taken under a different AggConfig "
+            "(operator config changed); ignoring", directory,
+        )
+        return False
+    if meta.get("n_shards") != store.agg.n_shards:
+        logger.warning(
+            "snapshot at %s has %s shards but this mesh has %s; ignoring",
+            directory, meta.get("n_shards"), store.agg.n_shards,
         )
         return False
 
