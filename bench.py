@@ -140,6 +140,7 @@ def main() -> None:
             break
         time.sleep(pass_gap_s if best >= good_floor else degraded_gap_s)
     rate = max(rates)
+    chronological = list(rates)  # all_passes keeps resampling order
     rates.sort()
     print(
         json.dumps(
@@ -153,7 +154,7 @@ def main() -> None:
                 # a reader sees exactly what was resampled and why
                 "passes": len(rates),
                 "median": round(rates[len(rates) // 2], 1),
-                "all_passes": [round(r, 1) for r in rates],
+                "all_passes": [round(r, 1) for r in chronological],
             }
         )
     )

@@ -175,22 +175,48 @@ class SpanColumns(NamedTuple):
         return SpanColumns(*(np.concatenate([a, b]) for a, b in zip(self, other)))
 
 
+# Packed wire image: 11 u32 rows = 44 B/span (was 17 rows / 68 B in r2;
+# the tunnel transfer is the measured end-to-end bottleneck, so narrow
+# lanes ride shared rows — PROFILE_r02.md "next perf dollar").
+#   rows 0-8: trace_h, tl0, tl1, s0, s1, p0, p1, dur, ts_min (plain u32)
+#   row 9:    svc << 16 | rsvc          (service ids, u16 each)
+#   row 10:   key << 8 | kind << 4 | has_dur << 3 | err << 2
+#             | shared << 1 | valid     (key u24 + 8 flag bits)
+WIRE_ROWS = 11
+_PLAIN = ("trace_h", "tl0", "tl1", "s0", "s1", "p0", "p1", "dur", "ts_min")
+# hard ceilings implied by the packing (AggConfig defaults: 1024 / 8192)
+MAX_WIRE_SERVICES = 1 << 16
+MAX_WIRE_KEYS = 1 << 24
+
+
 def fuse_columns(cols: SpanColumns) -> np.ndarray:
-    """One contiguous u32 image of a batch: ``[..., len(fields), n]``.
+    """One contiguous PACKED u32 image of a batch: ``[..., 11, n]``.
 
     Host->device transfer cost on a tunneled PJRT backend is dominated by
-    per-array dispatch overhead (17 small transfers per batch), so the
-    whole batch ships as ONE uint32 array and is re-typed on device by
-    :func:`zipkin_tpu.parallel.sharded.unfuse_columns` (i32 fields travel
-    bit-cast, bools as 0/1). Accepts per-shard stacked fields (leading
-    axes are preserved).
+    per-array dispatch overhead and raw bytes, so the whole batch ships
+    as ONE uint32 array — with the narrow fields (service ids, sketch
+    key, kind, flag bits) packed into shared rows — and is unpacked on
+    device by :func:`zipkin_tpu.parallel.sharded.unfuse_columns` (free
+    shifts/masks that XLA fuses into the consuming ops). Accepts
+    per-shard stacked fields (leading axes are preserved).
     """
-    fields = list(cols)
-    lead = fields[0].shape[:-1]
-    n = fields[0].shape[-1]
-    out = np.empty(lead + (len(fields), n), np.uint32)
-    for i, col in enumerate(fields):
-        out[..., i, :] = col.view(np.uint32) if col.dtype == np.int32 else col
+    d = cols._asdict()
+    lead = cols.valid.shape[:-1]
+    n = cols.valid.shape[-1]
+    out = np.empty(lead + (WIRE_ROWS, n), np.uint32)
+    for i, name in enumerate(_PLAIN):
+        out[..., i, :] = d[name]
+    out[..., 9, :] = (
+        (d["svc"].astype(np.uint32) << _U32(16)) | d["rsvc"].astype(np.uint32)
+    )
+    out[..., 10, :] = (
+        (d["key"].astype(np.uint32) << _U32(8))
+        | (d["kind"].astype(np.uint32) << _U32(4))
+        | (d["has_dur"].astype(np.uint32) << _U32(3))
+        | (d["err"].astype(np.uint32) << _U32(2))
+        | (d["shared"].astype(np.uint32) << _U32(1))
+        | d["valid"].astype(np.uint32)
+    )
     return out
 
 

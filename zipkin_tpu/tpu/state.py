@@ -70,6 +70,23 @@ class AggConfig:
     hist_slices: int = 8
     hist_slice_minutes: int = 60
 
+    def __post_init__(self) -> None:
+        # the packed wire image gives service ids 16 bits and sketch keys
+        # 24 (zipkin_tpu.tpu.columnar.fuse_columns); a config beyond that
+        # would silently alias ids on device
+        from zipkin_tpu.tpu.columnar import MAX_WIRE_KEYS, MAX_WIRE_SERVICES
+
+        if self.max_services > MAX_WIRE_SERVICES:
+            raise ValueError(
+                f"max_services ({self.max_services}) exceeds the packed "
+                f"wire limit ({MAX_WIRE_SERVICES})"
+            )
+        if self.max_keys > MAX_WIRE_KEYS:
+            raise ValueError(
+                f"max_keys ({self.max_keys}) exceeds the packed wire "
+                f"limit ({MAX_WIRE_KEYS})"
+            )
+
     @property
     def hll_rows(self) -> int:
         return self.max_services + 1
