@@ -114,3 +114,55 @@ class TestTpuServer:
             assert body["status"] == "UP"
 
         run(scenario)
+
+    def test_mp_ingest_tier_end_to_end(self):
+        """TPU_MP_WORKERS>0: POST returns 202 immediately, the worker
+        tier parses/packs, and queries see the spans after drain —
+        including the trace-affine sampled archive."""
+        from zipkin_tpu import native
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("native codec unavailable")
+
+        async def scenario_factory():
+            storage = TpuStorage(
+                config=SMALL, num_devices=2, fast_archive_sample=1
+            )
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    tpu_mp_workers=1, tpu_fast_ingest=True,
+                ),
+                storage=storage,
+            )
+            assert server._mp_ingester is not None
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                spans = lots_of_spans(1000, seed=5, services=4, span_names=6)
+                resp = await client.post(
+                    "/api/v2/spans", data=json_v2.encode_span_list(spans),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 202
+                await asyncio.to_thread(server._mp_ingester.drain)
+                resp = await client.get("/api/v2/tpu/counters")
+                counters = await resp.json()
+                assert counters["spans"] == len(spans)
+                # archive sampled at 1/1: every trace queryable
+                resp = await client.get(
+                    f"/api/v2/trace/{spans[0].trace_id}"
+                )
+                assert resp.status == 200
+                resp = await client.get("/metrics")
+                body = await resp.json()
+                assert body["counter.zipkin_collector.spans.http"] == len(
+                    spans
+                )
+            finally:
+                await client.close()
+                await server.stop()  # drains + closes the MP tier
+
+        asyncio.run(scenario_factory())

@@ -137,6 +137,7 @@ class Collector:
         sampler: Optional[CollectorSampler] = None,
         metrics: Optional[CollectorMetrics] = None,
         fast_ingest: bool = False,
+        mp_ingester=None,
     ) -> None:
         self.storage = storage
         self.sampler = sampler or CollectorSampler(1.0)
@@ -145,6 +146,10 @@ class Collector:
         # store's native columnar parser, skipping Span objects and the
         # raw-span archive (aggregates only — the v5e ingest headline)
         self.fast_ingest = fast_ingest and hasattr(storage, "ingest_json_fast")
+        # optional multi-process parse tier (tpu/mp_ingest.py): payloads
+        # are handed to worker processes and acked immediately — the
+        # reference's 202-on-enqueue semantics (SURVEY.md §3.2)
+        self.mp_ingester = mp_ingester
         self._consumer = storage.span_consumer()
 
     def accept_spans_bytes(
@@ -159,6 +164,23 @@ class Collector:
         """
         self.metrics.increment_messages()
         self.metrics.increment_bytes(len(data))
+        if (
+            self.mp_ingester is not None
+            # MP is the fast path's scale-out: it keeps the fast path's
+            # sampled-archive semantics, so it must never preempt the
+            # full-fidelity object path when fast ingest is off
+            and self.fast_ingest
+            and (encoding is None or encoding is codec.Encoding.JSON_V2)
+        ):
+            if encoding is not None or codec.detect(data) is codec.Encoding.JSON_V2:
+                # span/drop counters are incremented by the dispatcher as
+                # batches land (the ingester holds this collector's
+                # metrics); 0 = accepted asynchronously. A malformed
+                # payload is counted + logged by the dispatcher instead
+                # of HTTP-400'd — the at-least-once transports share
+                # this poison-pill semantic (SURVEY.md §3.3).
+                self.mp_ingester.submit(data)
+                return 0
         if self.fast_ingest and (
             encoding is None or encoding is codec.Encoding.JSON_V2
         ):

@@ -137,18 +137,39 @@ def available() -> bool:
     return _load() is not None
 
 
+#: every per-span column of a parse result (the slice set chunking and
+#: sampler filtering iterate over; ``data``/``n`` are handled separately)
+PARSED_FIELDS = (
+    "tl0", "tl1", "th0", "th1", "s0", "s1", "p0", "p1",
+    "shared", "kind", "err", "has_dur", "ts_us", "dur_us",
+    "debug", "svc_off", "svc_len", "rsvc_off", "rsvc_len",
+    "name_off", "name_len", "span_off", "span_len",
+    "svc_id", "rsvc_id", "name_id", "key_id",
+)
+
+
 class ParsedColumns:
     """Raw columnar parse result; string fields are (offset, len) slices
     into ``data`` (kept alive here). When parsed against a NativeVocab,
     the ``*_id`` columns are filled and interning is already done."""
 
-    __slots__ = (
-        "data", "n", "tl0", "tl1", "th0", "th1", "s0", "s1", "p0", "p1",
-        "shared", "kind", "err", "has_dur", "ts_us", "dur_us", "debug",
-        "svc_off", "svc_len", "rsvc_off", "rsvc_len", "name_off", "name_len",
-        "span_off", "span_len",
-        "svc_id", "rsvc_id", "name_id", "key_id",
-    )
+    __slots__ = ("data", "n") + PARSED_FIELDS
+
+
+def sampler_keep(parsed, n: int, boundary: int) -> np.ndarray:
+    """[n] bool: which parsed spans a boundary sampler keeps — the exact
+    numpy mirror of CollectorSampler.is_sampled on the trace id's low 64
+    bits (Java parity: abs(MIN_VALUE) maps to MAX_VALUE so it drops at
+    every rate < 1.0); debug spans always pass. Shared by the sync fast
+    path and the multi-process workers so the two tiers drop identically.
+    """
+    lo = (
+        parsed.tl1[:n].astype(np.uint64) << np.uint64(32)
+    ) | parsed.tl0[:n].astype(np.uint64)
+    signed = lo.view(np.int64)
+    t = np.abs(signed)
+    t = np.where(t == np.iinfo(np.int64).min, np.iinfo(np.int64).max, t)
+    return (t <= boundary) | (parsed.debug[:n] != 0)
 
 
 class NativeVocab:
@@ -175,6 +196,13 @@ class NativeVocab:
             raise MemoryError("zt_vocab_new failed")
         self._drain_buf = np.zeros(1 << 20, np.uint8)
         self._pair_buf = np.zeros(1 << 16, np.uint64)
+
+    @property
+    def overflow(self) -> int:
+        """Total intern attempts the C tables rejected at capacity (the
+        fast path's analog of Interner.overflow — overflowing entries
+        never reach the Python journal, so they must be read from C)."""
+        return int(self._lib.zt_vocab_overflow(self.handle))
 
     def counts(self):
         a = ctypes.c_uint32()

@@ -70,6 +70,7 @@ def build_storage(config: ServerConfig) -> StorageComponent:
             batch_size=config.tpu_batch_size,
             num_devices=config.tpu_devices,
             checkpoint_dir=config.tpu_checkpoint_dir,
+            wal_dir=config.tpu_wal_dir,
             config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
             fast_archive_sample=config.tpu_fast_archive_sample,
             **common,
@@ -95,11 +96,43 @@ class ZipkinServer:
                 self.storage, max_concurrency=self.config.throttle_max_concurrency
             )
         self.metrics = InMemoryCollectorMetrics()
+        sampler = CollectorSampler(self.config.sample_rate)
+        http_metrics = self.metrics.for_transport("http")
+        self._mp_ingester = None
+        if self.config.tpu_mp_workers > 0:
+            from zipkin_tpu import native
+            from zipkin_tpu.tpu.store import TpuStorage as _CoreTpu
+
+            # the MP tier needs the CORE store (it reaches the vocab and
+            # aggregator directly); a throttle wrapper still exposes it
+            # via .delegate
+            core = getattr(self.storage, "delegate", self.storage)
+            if (
+                isinstance(core, _CoreTpu)
+                and native.available()
+                and self.config.tpu_fast_ingest
+            ):
+                from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
+
+                self._mp_ingester = MultiProcessIngester(
+                    core,
+                    workers=self.config.tpu_mp_workers,
+                    sampler=sampler,
+                    metrics=http_metrics,
+                )
+            else:
+                logger.warning(
+                    "TPU_MP_WORKERS=%d ignored: requires STORAGE_TYPE=tpu, "
+                    "the native codec, and TPU_FAST_INGEST=true (the MP "
+                    "tier is the fast path's scale-out)",
+                    self.config.tpu_mp_workers,
+                )
         self.collector = Collector(
             self.storage,
-            sampler=CollectorSampler(self.config.sample_rate),
-            metrics=self.metrics.for_transport("http"),
+            sampler=sampler,
+            metrics=http_metrics,
             fast_ingest=self.config.tpu_fast_ingest,
+            mp_ingester=self._mp_ingester,
         )
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
@@ -200,6 +233,17 @@ class ZipkinServer:
             self._grpc = None
         if self._runner is not None:
             await self._runner.cleanup()
+        if self._mp_ingester is not None:
+            try:
+                # finish queued payloads before teardown (202s issued)
+                await asyncio.to_thread(self._mp_ingester.drain)
+            except Exception:
+                logger.exception("mp-ingest drain failed during stop")
+            finally:
+                # close() must always run: it joins the worker processes
+                # and unlinks the shared-memory block
+                await asyncio.to_thread(self._mp_ingester.close)
+                self._mp_ingester = None
         self.storage.close()
 
     # -- ingest ------------------------------------------------------------

@@ -66,7 +66,9 @@ class ServerConfig:
     tpu_batch_size: int = 8192
     tpu_fast_ingest: bool = False  # line-rate JSON->device path
     tpu_fast_archive_sample: int = 64  # 1/N traces archived in fast mode
+    tpu_mp_workers: int = 0  # >0: multi-process parse tier (mp_ingest)
     tpu_checkpoint_dir: Optional[str] = None
+    tpu_wal_dir: Optional[str] = None  # append-log of fused batches (tpu/wal.py)
     # device state shape (see zipkin_tpu.tpu.state.AggConfig); None =
     # AggConfig's default for that field
     tpu_agg: dict = dataclasses.field(default_factory=dict)
@@ -97,7 +99,9 @@ class ServerConfig:
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_fast_ingest=_env_bool("TPU_FAST_INGEST", False),
             tpu_fast_archive_sample=_env_int("TPU_FAST_ARCHIVE_SAMPLE", 64),
+            tpu_mp_workers=_env_int("TPU_MP_WORKERS", 0),
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
+            tpu_wal_dir=os.environ.get("TPU_WAL_DIR") or None,
             tpu_agg=_env_agg(),
         )
 

@@ -28,6 +28,7 @@ class TpuStorage(_CoreTpuStorage):
         search_enabled: bool = True,
         autocomplete_keys: Sequence[str] = (),
         fast_archive_sample: int = 64,
+        wal_dir: Optional[str] = None,
     ) -> None:
         mesh = None
         if num_devices is not None:
@@ -50,11 +51,31 @@ class TpuStorage(_CoreTpuStorage):
             from zipkin_tpu.tpu.snapshot import maybe_restore
 
             maybe_restore(self, checkpoint_dir)
+        if wal_dir:
+            # boot order matters: restore the snapshot first (sets
+            # agg.wal_seq to its cutoff), replay the WAL tail the
+            # snapshot missed, THEN attach the hook so new batches log
+            # with delta cursors at the post-replay vocab state
+            from zipkin_tpu.tpu import wal as wal_mod
+
+            wal = wal_mod.WriteAheadLog(wal_dir)
+            wal_mod.replay(self, wal, from_seq=self.agg.wal_seq)
+            wal_mod.attach(self, wal)
 
     def snapshot(self) -> Optional[str]:
-        """Persist device sketch state (see tpu/snapshot.py); returns path."""
+        """Persist device sketch state (see tpu/snapshot.py); returns
+        path. WAL segments fully covered by the snapshot are deleted."""
         if not self.checkpoint_dir:
             return None
-        from zipkin_tpu.tpu.snapshot import save
+        import json
+        import os
 
-        return save(self, self.checkpoint_dir)
+        from zipkin_tpu.tpu.snapshot import META_FILE, save
+
+        path = save(self, self.checkpoint_dir)
+        wal = getattr(self, "wal", None)
+        if wal is not None:
+            with open(os.path.join(path, META_FILE)) as f:
+                covered = json.load(f).get("wal_seq", 0)
+            wal.truncate_covered(covered)
+        return path

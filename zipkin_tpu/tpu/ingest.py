@@ -277,6 +277,25 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
     )
 
 
+def rolled_links(
+    config: AggConfig, state: AggState, ts_lo: jnp.ndarray, ts_hi: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(calls, errors) [S, S] u32 from the PRE-AGGREGATED rollup buckets
+    alone — the exact read the reference serves from its daily
+    ``dependency`` table (SURVEY.md §3.5 "read PRE-AGGREGATED daily link
+    rows ... merge days"). Correct whenever the window cannot intersect
+    any span resident in the live ring (the host tracks the resident
+    time range); costs a masked slot-sum instead of the ring lexsort."""
+    bm = config.bucket_minutes
+    lo_b = (ts_lo // jnp.uint32(bm)).astype(jnp.int32)
+    hi_b = (ts_hi // jnp.uint32(bm)).astype(jnp.int32)
+    sel = _slots_in_window(state.rollup_epoch, lo_b, hi_b)
+    return (
+        _masked_slot_sum(sel, state.rollup_calls),
+        _masked_slot_sum(sel, state.rollup_errs),
+    )
+
+
 def dependency_links(
     config: AggConfig,
     state: AggState,
@@ -297,13 +316,8 @@ def dependency_links(
     calls, errors = linker.emit_links(
         ctx, state.r_valid & ~state.r_rolled & in_window, config.max_services
     )
-    bm = config.bucket_minutes
-    lo_b = (ts_lo // jnp.uint32(bm)).astype(jnp.int32)
-    hi_b = (ts_hi // jnp.uint32(bm)).astype(jnp.int32)
-    sel = _slots_in_window(state.rollup_epoch, lo_b, hi_b)
-    calls = calls + _masked_slot_sum(sel, state.rollup_calls)
-    errors = errors + _masked_slot_sum(sel, state.rollup_errs)
-    return calls, errors
+    rc, re = rolled_links(config, state, ts_lo, ts_hi)
+    return calls + rc, errors + re
 
 
 def key_quantiles(state: AggState, qs: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,471 @@
+"""Multi-process parse/pack tier feeding the device aggregator.
+
+The reference scales ingest horizontally with N collector workers/nodes
+(Kafka partition parallelism, ``KafkaCollector.java`` — SURVEY.md §2.8);
+under CPython one process cannot: the r2 profile measured the device path
+at ~490k spans/s/chip with the host parse GIL-serialized at ~231k
+end-to-end, and a threaded feeder measured SLOWER (tpu/feeder.py). This
+module is the multi-process analog the round-2 verdict ordered:
+
+- **N parse workers** (``spawn``, never importing jax): raw JSON bytes ->
+  native C parse + LOCAL vocab interning -> columnar pack -> trace-affine
+  shard routing -> the packed 11-row wire image written into a shared-
+  memory slot. Workers journal newly-interned strings per batch.
+- **One dispatcher thread** (main process, owns the device): applies each
+  worker's vocab journal to the GLOBAL vocab, remaps the image's packed
+  service/key lanes worker-local -> global with three vectorized table
+  lookups, then ``ingest_fused`` (device_put + jit step). Remapping is
+  what lets workers intern lock-free: ids only need to be consistent
+  per-worker, the journal replays them into one global id space.
+
+Sampled archive parity: workers extract the same trace-affine 1/N span
+slices the synchronous fast path archives (byte extents from the native
+parser); the dispatcher re-decodes them with the reference codec, so
+``/api/v2/trace/{id}`` serves identical spans whichever tier ingested.
+
+On a single-core host this tier cannot beat the synchronous path (the
+workers and the PJRT client time-slice one core — measured and recorded
+in PROFILE_r03.md); it exists for multi-core hosts, where parse scales
+with worker count while the dispatcher stays a thin device feeder.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import multiprocessing as mp
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# worker -> dispatcher message kinds
+_KIND_BATCH = 0
+_KIND_FALLBACK = 1
+_KIND_EOF = 2
+
+
+def _extract_archive_slices(parsed, every: int) -> List[bytes]:
+    """The worker half of TpuStorage._archive_fast_sample: the exact JSON
+    byte extents of the trace-affine 1/N sample (same hash rule, so the
+    MP tier archives the same spans the sync path would)."""
+    from zipkin_tpu.tpu.columnar import _mix32
+
+    if every <= 0:
+        return []
+    n = parsed.n
+    tid = parsed.tl0[:n] ^ parsed.tl1[:n] ^ parsed.th0[:n] ^ parsed.th1[:n]
+    pick = np.nonzero(_mix32(tid) % np.uint32(every) == 0)[0]
+    data = parsed.data
+    off, ln = parsed.span_off, parsed.span_len
+    return [bytes(data[off[i] : off[i] + ln[i]]) for i in pick]
+
+
+def _worker_main(
+    widx: int,
+    work_q,
+    result_q,
+    shm_name: str,
+    slot_bytes: int,
+    slot_base: int,
+    n_slots: int,
+    slot_sem,
+    params: dict,
+) -> None:
+    """Parse worker entry point (child process; numpy + C parser only —
+    importing jax here would drag a PJRT client into every worker)."""
+    from multiprocessing import shared_memory
+
+    from zipkin_tpu import native
+    from zipkin_tpu.native import PARSED_FIELDS
+    from zipkin_tpu.tpu.columnar import Vocab, pack_parsed, route_fused
+
+    shm = shared_memory.SharedMemory(name=shm_name)
+    vocab = Vocab(params["max_services"], params["max_keys"])
+    nvocab = native.NativeVocab(vocab) if native.available() else None
+    n_shards = params["n_shards"]
+    max_batch = params["max_batch"]
+    pad = params["pad"]
+    every = params["archive_every"]
+    boundary = params["sample_boundary"]  # None = keep everything
+    # journal cursors: how much of the local vocab has been reported
+    sent_svc, sent_name, sent_pair = 1, 1, 1
+    slot_ids = itertools.cycle(range(n_slots))
+
+    def handle(payload: bytes, emitted: list) -> None:
+        nonlocal sent_svc, sent_name, sent_pair
+        parsed = (
+            native.parse_spans(payload, nvocab=nvocab)
+            if nvocab is not None
+            else None
+        )
+        if parsed is None:
+            # the strict-codec fallback needs Span objects: punt the
+            # raw payload back to the dispatcher's slow path
+            emitted.append(True)
+            result_q.put((_KIND_FALLBACK, widx, payload))
+            return
+        nvocab.sync()
+        n = parsed.n
+        dropped = 0
+        if boundary is not None and n:
+            keep = native.sampler_keep(parsed, n, boundary)
+            dropped = int(n - keep.sum())
+            if dropped:
+                idx = np.nonzero(keep)[0]
+                for field in PARSED_FIELDS:
+                    col = getattr(parsed, field, None)
+                    if col is not None:
+                        setattr(parsed, field, col[:n][idx])
+                parsed.n = n = len(idx)
+        if n == 0:
+            emitted.append(True)
+            result_q.put(
+                (_KIND_BATCH, widx, None, None, 0, 0, 0, dropped,
+                 [], [], [], [], (0, 0))
+            )
+            return
+        for lo in range(0, n, max_batch):
+            hi = min(lo + max_batch, n)
+            if lo == 0 and hi == n:
+                sub = parsed
+            else:
+                sub = native.ParsedColumns()
+                sub.data = parsed.data
+                for f in PARSED_FIELDS:
+                    col = getattr(parsed, f, None)
+                    setattr(sub, f, None if col is None else col[lo:hi])
+                sub.n = hi - lo
+            cols = pack_parsed(sub, vocab, pad)
+            fused = route_fused(cols, n_shards)
+            arch = _extract_archive_slices(sub, every)
+            # vocab journal since the last report (id order)
+            svc_new = vocab.services._names[sent_svc:]
+            name_new = vocab.span_names._names[sent_name:]
+            pairs_new = vocab._key_list[sent_pair:]
+            sent_svc += len(svc_new)
+            sent_name += len(name_new)
+            sent_pair += len(pairs_new)
+            slot_sem.acquire()
+            slot = next(slot_ids)
+            dst = np.frombuffer(
+                shm.buf, np.uint32, count=fused.size,
+                offset=slot_base + slot * slot_bytes,
+            )
+            dst[:] = fused.reshape(-1)
+            live_ts = cols.ts_min[cols.valid]
+            ts_range = (
+                (int(live_ts.min()), int(live_ts.max()))
+                if live_ts.size
+                else (0, 0)
+            )
+            emitted.append(True)
+            result_q.put(
+                (
+                    _KIND_BATCH, widx, slot, fused.shape,
+                    int(cols.valid.sum()),
+                    int((cols.valid & cols.has_dur).sum()),
+                    int((cols.valid & cols.err).sum()),
+                    # -1 marks a continuation chunk: the dispatcher
+                    # decrements inflight once per PAYLOAD, on the
+                    # first-chunk message (dropped >= 0)
+                    dropped if lo == 0 else -1,
+                    svc_new, name_new, pairs_new, arch, ts_range,
+                )
+            )
+
+    try:
+        while True:
+            item = work_q.get()
+            if item is None:
+                break
+            emitted: list = []
+            try:
+                handle(item, emitted)
+            except Exception:  # pragma: no cover - keep the pool alive
+                logging.getLogger(__name__).exception(
+                    "mp-ingest worker %d failed on a payload", widx
+                )
+                if not emitted:
+                    # nothing reached the dispatcher: whole payload takes
+                    # the slow path
+                    result_q.put((_KIND_FALLBACK, widx, item))
+                # else: the payload's first chunk already shipped (and
+                # will decrement inflight); remaining chunks are lost —
+                # logged above, bounded to one payload
+    finally:
+        result_q.put((_KIND_EOF, widx))
+        shm.close()
+
+
+class _IdMaps:
+    """Worker-local -> global id tables, grown as journals arrive."""
+
+    def __init__(self) -> None:
+        self.svc = np.zeros(1, np.uint32)  # local id 0 -> global 0
+        self.name = np.zeros(1, np.uint32)
+        self.key = np.zeros(1, np.uint32)
+
+    @staticmethod
+    def _append(arr: np.ndarray, values: List[int]) -> np.ndarray:
+        return np.concatenate([arr, np.asarray(values, np.uint32)]) if values else arr
+
+
+class MultiProcessIngester:
+    """Owns the worker pool + shared-memory slots + dispatcher thread.
+
+    ``submit(payload)`` enqueues raw JSON v2 bytes and returns once the
+    payload is accepted for processing (backpressure: the work queue is
+    bounded). ``drain()`` blocks until everything submitted has reached
+    the device. Parity with ``TpuStorage.ingest_json_fast`` — same
+    sketches, same sampled archive — is asserted in
+    tests/test_mp_ingest.py.
+    """
+
+    def __init__(
+        self,
+        store,
+        workers: int = 2,
+        slots_per_worker: int = 2,
+        sampler=None,
+        queue_depth: Optional[int] = None,
+        metrics=None,
+    ) -> None:
+        from zipkin_tpu import native
+        from zipkin_tpu.tpu.columnar import WIRE_ROWS
+
+        if not native.available():
+            raise RuntimeError("native codec unavailable; MP tier needs it")
+        self.store = store
+        self.workers = workers
+        self._sampler = sampler
+        agg = store.agg
+        # worst case: every span of a max_batch chunk routes to one
+        # shard, and route_fused rounds the per-shard lane count up to
+        # its 256 pad multiple — slots must cover the ROUNDED bound or a
+        # near-full chunk would write past its slot
+        per_cap = ((store.max_batch + 255) // 256) * 256
+        self._slot_bytes = agg.n_shards * WIRE_ROWS * per_cap * 4
+        self._slots_per_worker = slots_per_worker
+        ctx = mp.get_context("spawn")
+        total = self._slot_bytes * slots_per_worker * workers
+        from multiprocessing import shared_memory
+
+        self._shm = shared_memory.SharedMemory(create=True, size=total)
+        self._work_q = ctx.Queue(maxsize=queue_depth or 2 * workers)
+        self._result_q = ctx.Queue()
+        self._sems = [ctx.Semaphore(slots_per_worker) for _ in range(workers)]
+        params = dict(
+            max_services=store.vocab.services.capacity,
+            max_keys=store.vocab.max_keys,
+            n_shards=agg.n_shards,
+            max_batch=store.max_batch,
+            pad=store._pad,
+            archive_every=store._fast_archive_every,
+            sample_boundary=(
+                sampler._boundary
+                if sampler is not None and sampler.rate < 1.0
+                else None
+            ),
+        )
+        self._procs = [
+            ctx.Process(
+                target=_worker_main,
+                args=(
+                    w, self._work_q, self._result_q, self._shm.name,
+                    self._slot_bytes,
+                    w * slots_per_worker * self._slot_bytes,
+                    slots_per_worker, self._sems[w], params,
+                ),
+                daemon=True,
+            )
+            for w in range(workers)
+        ]
+        for p in self._procs:
+            p.start()
+        self.metrics = metrics  # CollectorMetrics-shaped, optional
+        self.counters = {"accepted": 0, "sampleDropped": 0, "fallbacks": 0}
+        self._inflight = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self._dispatch_error: Optional[BaseException] = None
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="mp-ingest-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+
+    # -- producer side ---------------------------------------------------
+
+    def submit(self, payload: bytes) -> None:
+        if self._closed:
+            raise RuntimeError("ingester closed")
+        if self._dispatch_error is not None:
+            raise RuntimeError("dispatcher died") from self._dispatch_error
+        with self._cv:
+            self._inflight += 1
+        self._work_q.put(payload)
+
+    def drain(self) -> None:
+        """Block until every submitted payload has reached the device."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._inflight == 0 or self._dispatch_error is not None
+            )
+        if self._dispatch_error is not None:
+            raise RuntimeError("dispatcher died") from self._dispatch_error
+        self.store.agg.block_until_ready()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._procs:
+            self._work_q.put(None)
+        for p in self._procs:
+            p.join(timeout=30)
+            if p.is_alive():  # pragma: no cover - hang safety
+                p.terminate()
+        self._dispatcher.join(timeout=30)
+        self._shm.close()
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:  # pragma: no cover
+            pass
+
+    # -- dispatcher ------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        try:
+            self._run_dispatch()
+        except BaseException as e:  # pragma: no cover - surfaced to callers
+            logger.exception("mp-ingest dispatcher failed")
+            self._dispatch_error = e
+            with self._cv:
+                self._cv.notify_all()
+
+    def _run_dispatch(self) -> None:
+        store = self.store
+        vocab = store.vocab
+        maps = [_IdMaps() for _ in range(self.workers)]
+        eofs = 0
+        while eofs < self.workers:
+            try:
+                msg = self._result_q.get(timeout=0.5)
+            except queue.Empty:
+                if self._closed and not any(p.is_alive() for p in self._procs):
+                    break
+                continue
+            kind = msg[0]
+            if kind == _KIND_EOF:
+                eofs += 1
+                continue
+            if kind == _KIND_FALLBACK:
+                _, widx, payload = msg
+                self._fallback(payload)
+                self.counters["fallbacks"] += 1
+                self._done_one()
+                continue
+            (
+                _, widx, slot, shape, n_spans, n_dur, n_err, dropped,
+                svc_new, name_new, pairs_new, arch, ts_range,
+            ) = msg
+            m = maps[widx]
+            if svc_new or name_new or pairs_new:
+                with store._intern_lock:
+                    m.svc = _IdMaps._append(
+                        m.svc, [vocab.services.intern(s) for s in svc_new]
+                    )
+                    m.name = _IdMaps._append(
+                        m.name, [vocab.span_names.intern(s) for s in name_new]
+                    )
+                    m.key = _IdMaps._append(
+                        m.key,
+                        [
+                            vocab.key_id(int(m.svc[sl]), int(m.name[nl]))
+                            for sl, nl in pairs_new
+                        ],
+                    )
+            if slot is not None:
+                size = int(np.prod(shape))
+                src = np.frombuffer(
+                    self._shm.buf, np.uint32, count=size,
+                    offset=widx * self._slots_per_worker * self._slot_bytes
+                    + slot * self._slot_bytes,
+                )
+                fused = src.reshape(shape).copy()
+                self._sems[widx].release()  # slot free the moment we copied
+                self._remap(fused, m)
+                if arch:
+                    self._archive(arch)
+                store.agg.ingest_fused(
+                    fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
+                    ts_range=ts_range,
+                )
+                self.counters["accepted"] += n_spans
+            self.counters["sampleDropped"] += max(dropped, 0)
+            if self.metrics is not None:
+                self.metrics.increment_spans(n_spans + max(dropped, 0))
+                if dropped > 0:
+                    self.metrics.increment_spans_dropped(dropped)
+            # dropped == -1 marks a continuation chunk; inflight
+            # decrements once per payload, on its first-chunk message
+            if dropped >= 0:
+                self._done_one()
+
+    def _done_one(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._cv.notify_all()
+
+    def _remap(self, fused: np.ndarray, m: _IdMaps) -> None:
+        """Worker-local ids -> global ids, in place on the packed image
+        (row 9 = svc<<16|rsvc, row 10 = key<<8|flags)."""
+        sr = fused[:, 9, :]
+        fused[:, 9, :] = (m.svc[sr >> 16] << np.uint32(16)) | m.svc[
+            sr & np.uint32(0xFFFF)
+        ]
+        kf = fused[:, 10, :]
+        fused[:, 10, :] = (m.key[kf >> 8] << np.uint32(8)) | (
+            kf & np.uint32(0xFF)
+        )
+
+    def _archive(self, slices: List[bytes]) -> None:
+        from zipkin_tpu.model import json_v2
+
+        spans = []
+        for raw in slices:
+            try:
+                spans.append(json_v2.decode_one_span(raw))
+            except Exception:  # slice the strict codec rejects: skip
+                continue
+        if spans:
+            self.store._archive.accept(spans).execute()
+
+    def _fallback(self, payload: bytes) -> None:
+        """Payloads the native parser rejects take the object path —
+        including the boundary sampler, so a parser punt cannot smuggle
+        unsampled spans into the store. Malformed payloads are counted
+        and dropped (the asynchronous-ack trade: like the reference's
+        Kafka collector, a poison message can't be HTTP-400'd after the
+        202 — SURVEY.md §3.3)."""
+        from zipkin_tpu.model import codec
+
+        try:
+            spans = codec.decode_spans(payload)
+        except Exception:
+            logger.warning("mp-ingest: undecodable payload dropped")
+            if self.metrics is not None:
+                self.metrics.increment_messages_dropped()
+            return
+        n_all = len(spans)
+        if self._sampler is not None:
+            spans = [s for s in spans if self._sampler.test(s)]
+        self.store.accept(spans).execute()
+        if self.metrics is not None:
+            self.metrics.increment_spans(n_all)
+            if n_all - len(spans):
+                self.metrics.increment_spans_dropped(n_all - len(spans))

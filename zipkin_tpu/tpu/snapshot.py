@@ -44,8 +44,13 @@ def save(store: "TpuStorage", directory: str) -> str:
     """Snapshot sketches + vocab into ``directory`` (atomic). Returns path."""
     os.makedirs(directory, exist_ok=True)
     # consistent copy under the aggregator lock: concurrent ingest donates
-    # the buffers this would otherwise be reading
-    arrays = {f"f{i}": leaf for i, leaf in enumerate(store.agg.state_arrays())}
+    # the buffers this would otherwise be reading. wal_seq is read under
+    # the SAME lock so "state + everything after wal_seq" is exact.
+    with store.agg.lock:
+        arrays = {
+            f"f{i}": leaf for i, leaf in enumerate(store.agg.state_arrays())
+        }
+        wal_seq = store.agg.wal_seq
 
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     with os.fdopen(fd, "wb") as f:  # file object: savez won't append ".npz"
@@ -55,6 +60,7 @@ def save(store: "TpuStorage", directory: str) -> str:
     meta = {
         "version": SNAPSHOT_VERSION,
         "saved_at": time.time(),
+        "wal_seq": wal_seq,
         "n_shards": store.agg.n_shards,
         "config": dataclasses.asdict(store.config),
         "counters": store.ingest_counters(),
@@ -126,5 +132,6 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
     }
     store.vocab._key_list = [tuple(k) for k in meta["keys"]]
     store.vocab._keys = {tuple(k): i for i, k in enumerate(meta["keys"]) if i}
+    store.agg.wal_seq = int(meta.get("wal_seq", 0))
     logger.info("restored TPU sketch snapshot from %s", directory)
     return True

@@ -1,0 +1,274 @@
+"""Host write-ahead log of fused wire batches (VERDICT r2 order 6).
+
+SURVEY.md §5's failure-detection row calls for a "host WAL of raw
+batches so a device restart replays the window": snapshots
+(tpu/snapshot.py) capture sketch state periodically, but HTTP/gRPC
+ingest BETWEEN snapshots lives only in volatile HBM — the reference
+never loses acked spans (durability is delegated to its storage
+backends; Kafka resumes from offsets). This module closes that gap for
+the device aggregates:
+
+- every batch that reaches ``ShardedAggregator.ingest_fused`` is
+  appended as one record: the packed ``[shards, 11, per]`` u32 wire
+  image (already contiguous — the append is a straight write, no
+  serialization) plus the GLOBAL vocab entries interned since the last
+  record, so replay reconstructs the identical id space;
+- records carry a monotone sequence number; snapshots store the last
+  sequence folded into the captured state, and restore replays only
+  ``seq > snapshot.wal_seq`` — exactly the batches the snapshot missed;
+- a crc over the payload detects the torn tail record of a mid-write
+  crash: replay stops cleanly at the last complete record;
+- segments rotate by size and are deleted once a newer snapshot covers
+  them.
+
+The sampled raw-span archive is NOT logged: it is a bounded, lossy
+cache by design (1-in-N traces, evicted by capacity), so replaying it
+would fake a durability the tier never promised. Counter/link/sketch
+parity after crash+replay is asserted in tests/test_wal.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import zlib
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = 0x5A57414C  # "ZWAL"
+_HEADER = struct.Struct("<IQII I")  # magic, seq, meta_len, payload_len, crc
+
+
+class WriteAheadLog:
+    def __init__(
+        self,
+        directory: str,
+        max_segment_bytes: int = 256 * 1024 * 1024,
+        fsync: bool = False,
+    ) -> None:
+        self.directory = directory
+        self.max_segment_bytes = max_segment_bytes
+        self.fsync = fsync
+        os.makedirs(directory, exist_ok=True)
+        self._fh = None
+        self._fh_bytes = 0
+        self._seg_idx = 0
+        self._seq = 0
+        # resume numbering after the existing records
+        for seq, _, _ in self.records():
+            self._seq = max(self._seq, seq)
+        segs = self._segments()
+        if segs:
+            self._seg_idx = segs[-1][0] + 1
+
+    # -- write side ------------------------------------------------------
+
+    def append(self, fused: np.ndarray, meta: dict) -> int:
+        """Append one batch; returns its sequence number. ``meta`` must
+        be JSON-serializable; shape/dtype are recorded automatically."""
+        self._seq += 1
+        payload = np.ascontiguousarray(fused, np.uint32).tobytes()
+        meta = dict(meta, shape=list(fused.shape))
+        meta_b = json.dumps(meta, separators=(",", ":")).encode()
+        rec = (
+            _HEADER.pack(
+                _MAGIC, self._seq, len(meta_b), len(payload),
+                zlib.crc32(payload),
+            )
+            + meta_b
+            + payload
+        )
+        fh = self._file_for(len(rec))
+        fh.write(rec)
+        fh.flush()
+        if self.fsync:
+            os.fsync(fh.fileno())
+        self._fh_bytes += len(rec)
+        return self._seq
+
+    def _file_for(self, rec_len: int):
+        if self._fh is not None and (
+            self._fh_bytes + rec_len > self.max_segment_bytes
+        ):
+            self._fh.close()
+            self._fh = None
+        if self._fh is None:
+            path = os.path.join(
+                self.directory, f"wal-{self._seg_idx:08d}.log"
+            )
+            self._seg_idx += 1
+            self._fh = open(path, "ab")
+            self._fh_bytes = os.path.getsize(path)
+        return self._fh
+
+    # -- read side -------------------------------------------------------
+
+    def _segments(self):
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("wal-") and name.endswith(".log"):
+                try:
+                    out.append(
+                        (int(name[4:-4]), os.path.join(self.directory, name))
+                    )
+                except ValueError:
+                    continue
+        out.sort()
+        return out
+
+    def records(
+        self, from_seq: int = 0
+    ) -> Iterator[Tuple[int, dict, np.ndarray]]:
+        """Yield (seq, meta, fused) for every complete record with
+        ``seq > from_seq``. A torn/corrupt record skips the REST OF ITS
+        SEGMENT only — in the designed crash scenario the torn record is
+        a segment's write tail, and LATER segments (appended by a
+        post-crash process) hold independently-acked batches whose vocab
+        deltas build on exactly the replay state at the tear, so they
+        must still replay (a whole-log stop here silently dropped them)."""
+        for _, path in self._segments():
+            with open(path, "rb") as fh:
+                while True:
+                    head = fh.read(_HEADER.size)
+                    if not head:
+                        break
+                    if len(head) < _HEADER.size:
+                        logger.warning(
+                            "WAL %s: torn header; skipping segment tail", path
+                        )
+                        break
+                    magic, seq, meta_len, payload_len, crc = _HEADER.unpack(
+                        head
+                    )
+                    if magic != _MAGIC:
+                        logger.warning(
+                            "WAL %s: bad magic; skipping segment tail", path
+                        )
+                        break
+                    meta_b = fh.read(meta_len)
+                    payload = fh.read(payload_len)
+                    if len(meta_b) < meta_len or len(payload) < payload_len:
+                        logger.warning(
+                            "WAL %s: torn record; skipping segment tail", path
+                        )
+                        break
+                    if zlib.crc32(payload) != crc:
+                        logger.warning(
+                            "WAL %s: bad crc; skipping segment tail", path
+                        )
+                        break
+                    if seq <= from_seq:
+                        continue
+                    meta = json.loads(meta_b)
+                    fused = np.frombuffer(payload, np.uint32).reshape(
+                        meta["shape"]
+                    )
+                    yield seq, meta, fused
+
+    # -- maintenance -----------------------------------------------------
+
+    def truncate_covered(self, covered_seq: int) -> None:
+        """Delete segments whose every record is <= covered_seq (already
+        folded into a durable snapshot)."""
+        for idx, path in self._segments():
+            if self._fh is not None and self._fh_bytes and idx == self._seg_idx - 1:
+                continue  # never unlink the live segment
+            max_seq = 0
+            try:
+                with open(path, "rb") as fh:
+                    while True:
+                        head = fh.read(_HEADER.size)
+                        if len(head) < _HEADER.size:
+                            break
+                        magic, seq, meta_len, payload_len, _ = _HEADER.unpack(
+                            head
+                        )
+                        if magic != _MAGIC:
+                            break
+                        max_seq = max(max_seq, seq)
+                        fh.seek(meta_len + payload_len, os.SEEK_CUR)
+            except OSError:
+                continue
+            if max_seq and max_seq <= covered_seq:
+                os.unlink(path)
+                logger.info("WAL segment %s truncated (<= %d)", path, covered_seq)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def attach(store, wal: WriteAheadLog) -> WriteAheadLog:
+    """Wire a WAL into a TpuStorage: every ingest_fused batch is logged
+    with the vocab delta since the previous record, and the aggregator
+    records the applied sequence for snapshot coordination. Call AFTER
+    any replay so the vocab delta cursors start at the current state."""
+    vocab = store.vocab
+    sent = {"svc": 1, "name": 1, "pair": 1}
+    # fast-forward the delta cursors past what a restored snapshot (or
+    # prior replay) already covers — those entries are in snapshot meta
+    sent["svc"] = len(vocab.services._names)
+    sent["name"] = len(vocab.span_names._names)
+    sent["pair"] = len(vocab._key_list)
+
+    def hook(fused, n_spans, n_dur, n_err, ts_range) -> int:
+        with store._intern_lock:
+            svc_new = vocab.services._names[sent["svc"]:]
+            name_new = vocab.span_names._names[sent["name"]:]
+            pairs_new = vocab._key_list[sent["pair"]:]
+            sent["svc"] += len(svc_new)
+            sent["name"] += len(name_new)
+            sent["pair"] += len(pairs_new)
+        return wal.append(
+            fused,
+            dict(
+                n_spans=n_spans, n_dur=n_dur, n_err=n_err,
+                ts_range=list(ts_range) if ts_range else None,
+                svc=svc_new, names=name_new,
+                pairs=[list(p) for p in pairs_new],
+            ),
+        )
+
+    store.agg.wal_hook = hook
+    store.wal = wal
+    return wal
+
+
+def replay(store, wal: WriteAheadLog, from_seq: int = 0) -> int:
+    """Re-apply every WAL record after ``from_seq`` (the snapshot's
+    cutoff) to the store: vocab deltas first (reconstructing the id
+    space in the original intern order), then the fused batch. The WAL
+    hook is suspended during replay. Returns batches applied."""
+    agg = store.agg
+    vocab = store.vocab
+    hook, agg.wal_hook = getattr(agg, "wal_hook", None), None
+    applied = 0
+    try:
+        for seq, meta, fused in wal.records(from_seq):
+            with store._intern_lock:
+                for s in meta.get("svc", []):
+                    vocab.services.intern(s)
+                for s in meta.get("names", []):
+                    vocab.span_names.intern(s)
+                for a, b in meta.get("pairs", []):
+                    vocab.key_id(a, b)
+            ts = meta.get("ts_range")
+            agg.ingest_fused(
+                np.array(fused),  # frombuffer view is read-only
+                n_spans=meta["n_spans"], n_dur=meta["n_dur"],
+                n_err=meta["n_err"],
+                ts_range=tuple(ts) if ts else None,
+            )
+            agg.wal_seq = seq
+            applied += 1
+    finally:
+        agg.wal_hook = hook
+    if applied:
+        logger.info("WAL: replayed %d batches (> seq %d)", applied, from_seq)
+    return applied
