@@ -350,9 +350,17 @@ def config4() -> bool:
     from zipkin_tpu.tpu.store import TpuStorage
 
     total = int(os.environ.get("EVAL_REPLAY_SPANS", 2_000_000))
-    batch = 65_536
+    if os.environ.get("EVAL_SMALL"):  # CPU smoke of the harness itself
+        cfg = AggConfig(
+            max_services=64, max_keys=256, hll_precision=8,
+            digest_centroids=16, digest_buffer=1 << 15,
+            ring_capacity=1 << 15, link_buckets=4, hist_slices=2,
+        )
+    else:
+        cfg = AggConfig()
+    batch = min(65_536, cfg.rollup_segment, cfg.digest_buffer)
     store = TpuStorage(
-        config=AggConfig(), mesh=make_mesh(1), pad_to_multiple=batch,
+        config=cfg, mesh=make_mesh(1), pad_to_multiple=batch,
         archive_max_span_count=100_000,
     )
     corpus = lots_of_spans(2 * batch, seed=400, services=40, span_names=80)
@@ -372,7 +380,10 @@ def config4() -> bool:
     else:  # pragma: no cover - no C toolchain
         sent = 0
 
-    KINDS = ("dependencies", "percentiles", "windowed", "cardinalities")
+    KINDS = (
+        "dependencies", "dependencies_fresh", "percentiles", "windowed",
+        "cardinalities",
+    )
     lat: dict = {k: [] for k in KINDS}  # mid-stream (under ingest load)
     quiesced: dict = {k: [] for k in KINDS}
 
@@ -392,9 +403,20 @@ def config4() -> bool:
             store.agg.write_version += 1
         else:
             store.invalidate_read_cache()
+        # the UI path: dependency answers may ride the bounded-staleness
+        # cache under load (TPU_DEPS_MAX_STALE_MS) — exactly what a
+        # polling Lens client experiences
         timed("dependencies",
               lambda: store.get_dependencies(end_ts, lookback).execute(),
               into)
+        # the worst case: force a from-scratch recompute (answer + device
+        # read caches cleared; under load the advanced write_version
+        # also forces the link-context rebuild)
+        def fresh():
+            store.invalidate_read_cache()
+            store.get_dependencies(end_ts, lookback).execute()
+
+        timed("dependencies_fresh", fresh, into)
         timed("percentiles",
               lambda: store.latency_quantiles([0.5, 0.99]), into)
         timed("windowed",
@@ -431,10 +453,73 @@ def config4() -> bool:
     # async ingest pipeline (reads and writes share the chip). With the
     # stream drained these measure the query programs themselves — the
     # first round pays the per-version link-context rebuild, later rounds
-    # ride the cached context (the polling-UI path between writes).
+    # ride the cached context (the polling-UI path between writes). The
+    # staleness cache is disabled here: quiesced rounds must measure
+    # device reads, not cache hits.
+    store._deps_max_stale_ms = 0.0
     query_round(quiesced)
     for _ in range(7):
         query_round(quiesced, fresh_version=False)
+
+    # Program-time capture (VERDICT r2 order 3): the relay's per-dispatch
+    # wall noise makes wall-minus-floor unreliable, so the 50ms SLO gate
+    # conditions on XPlane-captured DEVICE time per query program — the
+    # cost on a directly-attached v5e. Amortized programs are excluded:
+    # link_ctx is per-write-version (queries ride the cache), flush
+    # advances ingest state the stream would flush anyway.
+    program_ms: dict = {}
+    capture_error = None
+    trace_dir = None
+    captured_round = False
+    try:
+        import tempfile as _tempfile
+
+        import jax as _jax
+
+        trace_dir = _tempfile.mkdtemp(prefix="config4_slo_trace_")
+        with _jax.profiler.trace(trace_dir):
+            query_round(quiesced, fresh_version=False)
+            captured_round = True
+            store.agg.block_until_ready()
+        from benchmarks.xplane_tools import device_op_totals, latest_xspace
+
+        for op, (us, n) in device_op_totals(latest_xspace(trace_dir)).items():
+            if op.startswith("jit_spmd_"):
+                name = op.split("(")[0][len("jit_"):]
+                program_ms[name] = round(
+                    max(program_ms.get(name, 0.0), us / 1e3 / max(n, 1)), 3
+                )
+    except Exception as e:  # pragma: no cover - capture best-effort
+        capture_error = str(e)
+    finally:
+        # the capture round's timings include profiler overhead: drop
+        # them whether or not the xplane parse succeeded
+        if captured_round:
+            for v in quiesced.values():
+                if v:
+                    v.pop()
+        if trace_dir:
+            import shutil as _shutil
+
+            _shutil.rmtree(trace_dir, ignore_errors=True)
+
+    # Relay floor: a trivial one-scalar dispatch+fetch carries zero
+    # meaningful device work; its wall time is the backend's fixed
+    # per-dispatch cost (tens of ms through the driver's tunneled relay,
+    # microseconds on a directly-attached v5e). Program time = wall -
+    # floor; benchmarks/query_slo.py holds the XPlane capture proving
+    # the subtraction (committed as QUERY_SLO artifacts).
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1)
+    tiny(jnp.uint32(1)).block_until_ready()
+    floor = []
+    for _ in range(15):
+        f0 = time.perf_counter()
+        np.asarray(tiny(jnp.uint32(1)))
+        floor.append((time.perf_counter() - f0) * 1e3)
+    floor_p50 = sorted(floor)[len(floor) // 2]
 
     def stats(xs):
         if not xs:
@@ -446,12 +531,43 @@ def config4() -> bool:
     counters = store.ingest_counters()
     q_stats = {k: stats(v) for k, v in lat.items()}
     quiesced_stats = {k: stats(v) for k, v in quiesced.items()}
-    # dual gate: quiesced p50 against the 50ms SLO (the query cost
-    # itself) AND mid-stream p50 against a 2s queueing bound (read-while-
-    # write regressions must still fail the eval)
-    slo_ok = all(
-        s is None or s["p50"] < 50.0 for s in quiesced_stats.values()
-    ) and all(s is None or s["p50"] < 2000.0 for s in q_stats.values())
+    # Gates (r3, per VERDICT r2 orders 3+4):
+    # (a) captured DEVICE time per query program < 50ms (program_ms,
+    #     amortized programs excluded — see capture comment above);
+    #     the from-scratch dependencies_fresh rebuild is amortized per
+    #     write-version, not paid per query, so it reports but does not
+    #     gate;
+    # (b) under-load p50 < 500ms for every UI read (tightened from r2's
+    #     2s; the staleness cache + rolled-only reads are what a polling
+    #     client rides);
+    # (c) under-load from-scratch dependency rebuild p50 < 5s, reported.
+    AMORTIZED = {"spmd_link_ctx", "spmd_flush", "spmd_rollup",
+                 "spmd_quant_digest"}
+    gated_programs = {
+        k: v for k, v in program_ms.items() if k not in AMORTIZED
+    }
+    if gated_programs:
+        slo_program_ok = all(v < 50.0 for v in gated_programs.values())
+        slo_gate = "program_device_time"
+    else:
+        # capture unavailable (no protoc / profiler broken): fall back
+        # to wall-minus-floor — noisier through a relay but never skips
+        # the gate entirely
+        slo_program_ok = all(
+            s is None or (s["p50"] - floor_p50) < 50.0
+            for k, s in quiesced_stats.items()
+            if k != "dependencies_fresh"
+        )
+        slo_gate = "wall_minus_floor"
+    load_ok = all(
+        s is None or s["p50"] < 500.0
+        for k, s in q_stats.items() if k != "dependencies_fresh"
+    )
+    fresh_ok = (
+        q_stats["dependencies_fresh"] is None
+        or q_stats["dependencies_fresh"]["p50"] < 5000.0
+    )
+    slo_ok = slo_program_ok and load_ok and fresh_ok
     trace_readable = bool(store.get_service_names().execute())
     ok = (
         counters["spans"] == sent
@@ -464,7 +580,12 @@ def config4() -> bool:
           query_rounds=len(lat["dependencies"]),
           query_latency_under_load_ms=q_stats,
           query_latency_quiesced_ms=quiesced_stats,
-          slo_quiesced_p50_under_50ms=slo_ok,
+          relay_floor_ms=round(floor_p50, 2),
+          query_program_device_ms=program_ms,
+          slo_gate=slo_gate,
+          capture_error=capture_error,
+          slo_program_device_under_50ms=slo_program_ok,
+          under_load_p50_under_500ms=load_ok,
           archive_readable_in_fast_mode=trace_readable)
     return bool(ok and slo_ok)
 

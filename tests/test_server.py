@@ -357,3 +357,20 @@ class TestThrottle:
                 await client.close()
 
         asyncio.run(scenario())
+
+
+class TestUiPage:
+    def test_ui_page_serves_span_detail_panel(self):
+        async def scenario(client):
+            resp = await client.get("/zipkin/")
+            assert resp.status == 200
+            page = await resp.text()
+            # r3 additions: span-detail panel + percentile context in
+            # the waterfall + red error bars
+            for marker in (
+                'id="spanpanel"', "spanDetail(", "vs p99",
+                ".bar.err", "loadPctCtx",
+            ):
+                assert marker in page, marker
+
+        run(scenario)

@@ -571,10 +571,16 @@ class ShardedAggregator:
     def _flush_now(self) -> None:
         """Compact the pending digest buffer and reset the host mirror —
         the ONLY correct way to run the flush program (state swap and
-        mirror reset are one invariant). Callers hold the lock."""
+        mirror reset are one invariant). Callers hold the lock.
+
+        Deliberately does NOT bump write_version: a flush is
+        query-INVISIBLE (the pend-fold and no-pend digest reads are
+        bit-identical by construction, and flush touches nothing else),
+        so cached reads and the link context stay valid — which is what
+        lets a percentile read flush opportunistically without
+        invalidating every other cached answer."""
         self.state = self._flush(self.state)
         self._pend_lanes = 0
-        self.write_version += 1
 
     def warm_programs(self, cols: SpanColumns) -> None:
         """Compile every program the steady-state ingest loop can
@@ -646,10 +652,15 @@ class ShardedAggregator:
                     qarr,
                 )
             elif source == "digest":
-                if self._pend_lanes == 0:
-                    q, n = self._quant_digest_nopend(self.state, qarr)
-                else:
-                    q, n = self._quant_digest(self.state, qarr)
+                if self._pend_lanes:
+                    # flush-then-read beats the pend-fold read variant:
+                    # the fold costs the same compaction (75ms device at
+                    # full shapes, QUERY_SLO r3 capture) WITHOUT
+                    # advancing state, so every query would re-pay it;
+                    # the flush pays it once and the read itself rides
+                    # the cheap no-pend program
+                    self._flush_now()
+                q, n = self._quant_digest_nopend(self.state, qarr)
             else:
                 q, n = self._quant_hist(self.state, qarr)
             return np.asarray(q), np.asarray(n)

@@ -3,7 +3,8 @@
 The reference serves the Lens React bundle from the server jar
 (SURVEY.md §2.5); the rebuild keeps **API-shape compatibility** so Lens
 itself can be pointed at this server, and ships this small dependency-free
-page for the same three views (search, trace detail, dependencies) plus
+page for the same three views (search, trace detail with a span-detail
+panel and sketch-served duration-percentile context, dependencies) plus
 the TPU percentile extension — consuming only the public JSON API.
 """
 
@@ -19,9 +20,18 @@ PAGE = """<!doctype html>
  table{border-collapse:collapse;width:100%;font-size:13px}
  td,th{border-bottom:1px solid #eee;padding:4px 6px;text-align:left}
  .bar{background:#3f51b5;height:10px;border-radius:2px}
+ .bar.err{background:#b71c1c}
  .err{color:#b71c1c}
+ .slow{color:#e65100;font-weight:600}
  select,input,button{font-size:13px;padding:3px 6px}
  .muted{color:#777}
+ tr.srow{cursor:pointer}
+ tr.srow:hover{background:#f0f2ff}
+ #spanpanel{position:fixed;right:0;top:0;bottom:0;width:360px;background:#fff;
+  border-left:2px solid #1a237e;padding:12px;overflow:auto;box-shadow:-2px 0 8px #0002;display:none}
+ #spanpanel h3{margin:0 0 8px;font-size:14px}
+ #spanpanel table{font-size:12px}
+ #spanpanel .close{float:right}
 </style></head><body>
 <header><h1>zipkin-tpu</h1><span id="info" class="muted"></span></header>
 <main>
@@ -39,6 +49,7 @@ PAGE = """<!doctype html>
  <table id="pcttab"><tr><th>service</th><th>span</th><th>count</th><th>p50 µs</th><th>p99 µs</th></tr></table>
 </section>
 </main>
+<div id="spanpanel"></div>
 <script>
 const $=q=>document.querySelector(q);
 const get=async p=>{const r=await fetch(p);if(!r.ok)throw new Error(p+': '+r.status);return r.json()};
@@ -70,21 +81,72 @@ async function findTraces(){
   }
   el.append(t);
 }
+let curSpans=[];   // spans of the open trace, for the detail panel
+let pctCtx={};     // (service|span) -> {p50, p99} percentile context
+async function loadPctCtx(){
+  if(Object.keys(pctCtx).length)return;
+  try{const rows=await get('/api/v2/tpu/percentiles?q=0.5,0.99');
+    for(const x of rows)pctCtx[x.serviceName+'|'+x.spanName]=
+      {p50:x.quantiles['0.5'],p99:x.quantiles['0.99']};
+  }catch(e){/* TPU sketches not enabled: waterfall renders without context */}
+}
 async function detail(id){
   const spans=await get('/api/v2/trace/'+id);
+  await loadPctCtx();
+  curSpans=spans.sort((a,b)=>(a.timestamp||0)-(b.timestamp||0));
   const t0=Math.min(...spans.map(s=>s.timestamp||1e18));
   const total=Math.max(...spans.map(s=>(s.timestamp||t0)+(s.duration||0)))-t0||1;
   const el=$('#detail');
-  let h=`<h2>trace ${esc(hexOnly(id))}</h2><table><tr><th>service</th><th>span</th><th>timeline</th><th>µs</th></tr>`;
-  for(const s of spans.sort((a,b)=>(a.timestamp||0)-(b.timestamp||0))){
+  let h=`<h2>trace ${esc(hexOnly(id))} <span class="muted">(click a span for detail)</span></h2>
+    <table><tr><th>service</th><th>span</th><th>timeline</th><th>µs</th><th>vs p99</th></tr>`;
+  curSpans.forEach((s,i)=>{
     const off=100*((s.timestamp||t0)-t0)/total, w=Math.max(100*(s.duration||0)/total,0.5);
     const err=s.tags&&s.tags.error!==undefined;
-    h+=`<tr class="${err?'err':''}"><td>${esc((s.localEndpoint||{}).serviceName||'')}</td>
+    const key=((s.localEndpoint||{}).serviceName||'')+'|'+(s.name||'');
+    const ctx=pctCtx[key];
+    // duration-percentile context from the device sketches (the Lens
+    // "how slow is this span vs its peers" panel)
+    let vs='';
+    if(ctx&&s.duration){
+      const r=s.duration/ctx.p99;
+      vs=r>=1?`<span class="slow">${r.toFixed(1)}x p99</span>`
+             :s.duration>=ctx.p50?'&gt;p50':'&lt;p50';
+    }
+    h+=`<tr class="srow ${err?'err':''}" onclick="spanDetail(${i})">
+      <td>${esc((s.localEndpoint||{}).serviceName||'')}</td>
       <td>${esc(s.name||'')} ${esc(s.kind||'')}</td>
-      <td style="width:50%"><div class="bar" style="margin-left:${off}%;width:${w}%"></div></td>
-      <td>${esc(s.duration||'')}</td></tr>`;
-  }
+      <td style="width:45%"><div class="bar ${err?'err':''}" style="margin-left:${off}%;width:${w}%"></div></td>
+      <td>${esc(s.duration||'')}</td><td>${vs}</td></tr>`;
+  });
   el.innerHTML=h+'</table>';
+}
+function spanDetail(i){
+  const s=curSpans[i];if(!s)return;
+  const row=(k,v)=>v===undefined||v===''?'':`<tr><th>${esc(k)}</th><td>${esc(v)}</td></tr>`;
+  const ep=e=>e?[e.serviceName,e.ipv4||e.ipv6,e.port].filter(Boolean).join(' '):'';
+  let h=`<button class="close" onclick="$('#spanpanel').style.display='none'">×</button>
+    <h3>${esc(s.name||'(unnamed)')} <span class="muted">${esc(s.kind||'')}</span></h3><table>`;
+  h+=row('traceId',s.traceId)+row('spanId',s.id)+row('parentId',s.parentId)
+    +row('shared',s.shared?'true':'')+row('timestamp µs',s.timestamp)
+    +row('duration µs',s.duration)
+    +row('local',ep(s.localEndpoint))+row('remote',ep(s.remoteEndpoint));
+  const key=((s.localEndpoint||{}).serviceName||'')+'|'+(s.name||'');
+  const ctx=pctCtx[key];
+  if(ctx)h+=row('peer p50 µs',Math.round(ctx.p50))+row('peer p99 µs',Math.round(ctx.p99));
+  h+='</table>';
+  if(s.annotations&&s.annotations.length){
+    h+='<h3>annotations</h3><table>';
+    for(const a of s.annotations)h+=row(a.timestamp,a.value);
+    h+='</table>';
+  }
+  const tags=s.tags||{};
+  if(Object.keys(tags).length){
+    h+='<h3>tags</h3><table>';
+    for(const k of Object.keys(tags).sort())
+      h+=`<tr><th class="${k==='error'?'err':''}">${esc(k)}</th><td>${esc(tags[k])}</td></tr>`;
+    h+='</table>';
+  }
+  const p=$('#spanpanel');p.innerHTML=h;p.style.display='block';
 }
 async function deps(){
   const links=await get('/api/v2/dependencies?endTs='+Date.now()+'&lookback='+7*864e5);

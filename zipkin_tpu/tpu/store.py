@@ -323,12 +323,14 @@ class TpuStorage(
     # -- aggregate reads: device ----------------------------------------
 
     def _cached_read(self, key: str, compute):
-        """Memoize a device pull until the next state mutation (the
-        aggregator bumps write_version on step/flush/rollup/restore);
-        the device state is immutable between mutations. The whole cache
-        drops when the version advances — keys embed window minutes and
-        quantile lists, so per-key staleness checks alone would let dead
-        entries accumulate forever under a polling UI."""
+        """Memoize a device pull until the next QUERY-VISIBLE state
+        mutation: the aggregator bumps write_version on step, rollup and
+        restore — deliberately NOT on a digest flush, which changes no
+        answer (the pend-fold and no-pend reads are bit-identical), so a
+        read-triggered flush keeps every cached answer valid. The whole
+        cache drops when the version advances — keys embed window
+        minutes and quantile lists, so per-key staleness checks alone
+        would let dead entries accumulate forever under a polling UI."""
         version = self.agg.write_version
         with self._read_cache_lock:
             if self._read_cache_version != version:
@@ -344,10 +346,12 @@ class TpuStorage(
         return value
 
     def invalidate_read_cache(self) -> None:
-        """Drop memoized device pulls (keeps the aggregator's link
-        context). For harnesses that must re-measure device reads."""
+        """Drop memoized device pulls, including cached dependency
+        answers (keeps the aggregator's link context). For harnesses
+        that must re-measure device reads."""
         with self._read_cache_lock:
             self._read_cache.clear()
+            self._deps_cache.clear()
 
     def get_dependencies(self, end_ts: int, lookback: int) -> Call[List[DependencyLink]]:
         def run() -> List[DependencyLink]:
