@@ -163,3 +163,40 @@ def test_snapshot_truncates_covered_segments(tmp_path):
     for spans in batches(5):
         oracle.accept(spans).execute()
     assert_query_parity(oracle, revived)
+
+
+def test_mp_ingest_batches_are_wal_logged(tmp_path):
+    """The WAL hook sits at ingest_fused, so batches arriving via the
+    multi-process tier must replay after a crash exactly like
+    synchronous ones (vocab deltas flow through the dispatcher's global
+    interning before the hook fires)."""
+    from zipkin_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native codec unavailable")
+    from zipkin_tpu.model.json_v2 import encode_span_list
+    from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
+
+    bs = batches(3)
+    payloads = [encode_span_list(spans) for spans in bs]
+
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for p in payloads:
+        oracle.ingest_json_fast(p)
+
+    victim = make(tmp_path)
+    ing = MultiProcessIngester(victim, workers=1)
+    try:
+        for p in payloads:
+            ing.submit(p)
+        ing.drain()
+    finally:
+        ing.close()
+    assert victim.agg.wal_seq > 0
+    del victim  # crash
+
+    revived = make(tmp_path)
+    assert_query_parity(oracle, revived)
+    assert revived.vocab.services._names == oracle.vocab.services._names
