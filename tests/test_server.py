@@ -369,7 +369,7 @@ class TestUiPage:
             # the waterfall + red error bars
             for marker in (
                 'id="spanpanel"', "spanDetail(", "vs p99",
-                ".bar.err", "loadPctCtx",
+                ".bar.err", "loadPctCtx", 'id="depgraph"', "depGraph(",
             ):
                 assert marker in page, marker
 

@@ -43,6 +43,7 @@ PAGE = """<!doctype html>
  <div id="detail"></div>
 </section>
 <section><h2>Dependencies</h2><button onclick="deps()">refresh</button>
+ <svg id="depgraph" width="100%" height="0" viewBox="0 0 800 500"></svg>
  <table id="deptab"><tr><th>parent</th><th>child</th><th>calls</th><th>errors</th></tr></table>
 </section>
 <section><h2>Latency percentiles (TPU sketches)</h2><button onclick="pcts()">refresh</button>
@@ -154,6 +155,62 @@ async function deps(){
   for(const l of links){const r=document.createElement('tr');
     r.innerHTML=`<td>${esc(l.parent)}</td><td>${esc(l.child)}</td><td>${esc(l.callCount)}</td>
       <td class="${l.errorCount?'err':''}">${esc(l.errorCount||0)}</td>`;t.append(r)}
+  depGraph(links);
+}
+function depGraph(links){
+  // service graph (the Lens dependencies view): nodes on a circle,
+  // directed edges with width ~ log(calls), red when errors flow.
+  // Built with createElementNS + textContent only — span/service names
+  // are attacker-controlled and never touch innerHTML here.
+  const svg=$('#depgraph');const NS='http://www.w3.org/2000/svg';
+  svg.innerHTML='';
+  // rank services by call volume so a >48-service graph keeps the
+  // heavy hitters, and SAY what was dropped (a silently truncated
+  // graph reads as "those call paths do not exist")
+  const vol={};
+  for(const l of links){vol[l.parent]=(vol[l.parent]||0)+(l.callCount||0);
+    vol[l.child]=(vol[l.child]||0)+(l.callCount||0)}
+  const all=Object.keys(vol).sort((a,b)=>vol[b]-vol[a]);
+  const names=all.slice(0,48);
+  if(!names.length){svg.setAttribute('height','0');return}
+  svg.setAttribute('height','500');
+  const cx=400,cy=250,R=Math.min(200,60+names.length*8);
+  const pos={};
+  names.forEach((n,i)=>{const a=2*Math.PI*i/names.length-Math.PI/2;
+    pos[n]=[cx+R*Math.cos(a),cy+R*Math.sin(a)]});
+  const el=(k,at)=>{const e=document.createElementNS(NS,k);
+    for(const[a,v]of Object.entries(at))e.setAttribute(a,v);return e};
+  const maxC=Math.max(...links.map(l=>l.callCount||1));
+  for(const l of links){
+    const p=pos[l.parent],c=pos[l.child];if(!p||!c)continue;
+    const w=0.8+3*Math.log(1+(l.callCount||1))/Math.log(1+maxC);
+    // curve through a point pulled toward the center so opposite-direction
+    // edges between the same pair stay distinguishable
+    const mx=(p[0]+c[0])/2+(cy-(p[1]+c[1])/2)*0.25,
+          my=(p[1]+c[1])/2+((p[0]+c[0])/2-cx)*0.25;
+    const path=el('path',{d:`M${p[0]},${p[1]} Q${mx},${my} ${c[0]},${c[1]}`,
+      fill:'none',stroke:l.errorCount?'#b71c1c':'#7986cb','stroke-width':w,opacity:0.75});
+    const tip=document.createElementNS(NS,'title');
+    tip.textContent=`${l.parent} -> ${l.child}: ${l.callCount} calls, ${l.errorCount||0} errors`;
+    path.append(tip);svg.append(path);
+    // direction tick at 70% along the curve
+    const tx=0.09*p[0]+0.42*mx+0.49*c[0],ty=0.09*p[1]+0.42*my+0.49*c[1];
+    svg.append(el('circle',{cx:tx,cy:ty,r:Math.max(w,1.6),
+      fill:l.errorCount?'#b71c1c':'#3f51b5'}));
+  }
+  for(const n of names){
+    const[x,y]=pos[n];
+    svg.append(el('circle',{cx:x,cy:y,r:5,fill:'#1a237e'}));
+    const label=el('text',{x:x+(x>=cx?8:-8),y:y+4,'font-size':'11',
+      'text-anchor':x>=cx?'start':'end',fill:'#222'});
+    label.textContent=n;  // textContent: no markup interpretation
+    svg.append(label);
+  }
+  if(all.length>names.length){
+    const note=el('text',{x:10,y:20,'font-size':'12',fill:'#b71c1c'});
+    note.textContent=`${all.length-names.length} lower-volume services not shown (full list in the table below)`;
+    svg.append(note);
+  }
 }
 async function pcts(){
   try{
