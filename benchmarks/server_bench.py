@@ -1,0 +1,102 @@
+"""HTTP-level ingest benchmark: the reference's §3.2 throughput path.
+
+`bench.py` measures the library boundary (bytes -> device sketches);
+this harness measures the whole server: aiohttp request handling, gzip
+sniffing, collector dispatch, then the same fast path — i.e. what a load
+balancer in front of `POST /api/v2/spans` would see. On a one-core host
+the aiohttp event loop, the parser and the PJRT client share the CPU,
+so this is a lower bound on a real ingest node.
+
+Run from the repo root: ``python -m benchmarks.server_bench``
+(SERVER_BENCH_SPANS, SERVER_BENCH_MP_WORKERS envs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+
+async def run() -> dict:
+    from aiohttp import ClientSession, TCPConnector
+
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+    from zipkin_tpu.storage.tpu import TpuStorage
+
+    total = int(os.environ.get("SERVER_BENCH_SPANS", 2_000_000))
+    workers = int(os.environ.get("SERVER_BENCH_MP_WORKERS", 0))
+    batch = 65_536
+    port = int(os.environ.get("SERVER_BENCH_PORT", 19419))
+
+    storage = TpuStorage(batch_size=batch, num_devices=1)
+    server = ZipkinServer(
+        ServerConfig(
+            port=port, host="127.0.0.1", storage_type="tpu",
+            tpu_fast_ingest=True, tpu_mp_workers=workers,
+        ),
+        storage=storage,
+    )
+    await server.start()
+
+    spans = lots_of_spans(2 * batch, seed=7, services=40, span_names=120)
+    payloads = [
+        json_v2.encode_span_list(spans[i : i + batch])
+        for i in range(0, len(spans), batch)
+    ]
+    storage.warm(payloads[0])
+    warm = storage.ingest_counters()["spans"]
+
+    url = f"http://127.0.0.1:{port}/api/v2/spans"
+    sent = warm
+    t0 = time.perf_counter()
+    async with ClientSession(connector=TCPConnector(limit=4)) as sess:
+        i = 0
+        # two requests in flight: the server acks 202 on enqueue, so a
+        # single serial client would measure its own think time
+        pending = set()
+        while sent < total + warm or pending:
+            while sent < total + warm and len(pending) < 2:
+                pending.add(
+                    asyncio.create_task(
+                        sess.post(
+                            url, data=payloads[i % len(payloads)],
+                            headers={"Content-Type": "application/json"},
+                        )
+                    )
+                )
+                i += 1
+                sent += batch
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for d in done:
+                resp = d.result()
+                assert resp.status == 202, resp.status
+                resp.release()
+    if server._mp_ingester is not None:
+        await asyncio.to_thread(server._mp_ingester.drain)
+    storage.agg.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    accepted = storage.ingest_counters()["spans"] - warm
+    await server.stop()
+    return {
+        "metric": "server_http_ingest_spans_per_sec",
+        "value": round(accepted / elapsed, 1),
+        "unit": "spans/s",
+        "spans": accepted,
+        "mp_workers": workers,
+        "vs_library_path": "see BENCH artifacts (bench.py json mode)",
+    }
+
+
+def main() -> None:
+    print(json.dumps(asyncio.run(run())), flush=True)
+
+
+if __name__ == "__main__":
+    main()

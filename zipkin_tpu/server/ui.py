@@ -166,23 +166,27 @@ function depGraph(links){
   svg.innerHTML='';
   // rank services by call volume so a >48-service graph keeps the
   // heavy hitters, and SAY what was dropped (a silently truncated
-  // graph reads as "those call paths do not exist")
-  const vol={};
-  for(const l of links){vol[l.parent]=(vol[l.parent]||0)+(l.callCount||0);
-    vol[l.child]=(vol[l.child]||0)+(l.callCount||0)}
-  const all=Object.keys(vol).sort((a,b)=>vol[b]-vol[a]);
+  // graph reads as "those call paths do not exist"). Maps, not plain
+  // objects: service names are attacker-controlled and "__proto__" /
+  // "constructor" would corrupt object-keyed lookups.
+  const vol=new Map();
+  for(const l of links){vol.set(l.parent,(vol.get(l.parent)||0)+(l.callCount||0));
+    vol.set(l.child,(vol.get(l.child)||0)+(l.callCount||0))}
+  const all=[...vol.keys()].sort((a,b)=>vol.get(b)-vol.get(a));
   const names=all.slice(0,48);
   if(!names.length){svg.setAttribute('height','0');return}
   svg.setAttribute('height','500');
   const cx=400,cy=250,R=Math.min(200,60+names.length*8);
-  const pos={};
+  const pos=new Map();
   names.forEach((n,i)=>{const a=2*Math.PI*i/names.length-Math.PI/2;
-    pos[n]=[cx+R*Math.cos(a),cy+R*Math.sin(a)]});
+    pos.set(n,[cx+R*Math.cos(a),cy+R*Math.sin(a)])});
   const el=(k,at)=>{const e=document.createElementNS(NS,k);
     for(const[a,v]of Object.entries(at))e.setAttribute(a,v);return e};
-  const maxC=Math.max(...links.map(l=>l.callCount||1));
+  // reduce, not Math.max(...spread): a 100k-link response would blow
+  // the JS argument-count limit
+  const maxC=links.reduce((m,l)=>Math.max(m,l.callCount||1),1);
   for(const l of links){
-    const p=pos[l.parent],c=pos[l.child];if(!p||!c)continue;
+    const p=pos.get(l.parent),c=pos.get(l.child);if(!p||!c)continue;
     const w=0.8+3*Math.log(1+(l.callCount||1))/Math.log(1+maxC);
     // curve through a point pulled toward the center so opposite-direction
     // edges between the same pair stay distinguishable
@@ -199,7 +203,7 @@ function depGraph(links){
       fill:l.errorCount?'#b71c1c':'#3f51b5'}));
   }
   for(const n of names){
-    const[x,y]=pos[n];
+    const[x,y]=pos.get(n);
     svg.append(el('circle',{cx:x,cy:y,r:5,fill:'#1a237e'}));
     const label=el('text',{x:x+(x>=cx?8:-8),y:y+4,'font-size':'11',
       'text-anchor':x>=cx?'start':'end',fill:'#222'});
