@@ -200,3 +200,56 @@ def test_mp_ingest_batches_are_wal_logged(tmp_path):
     revived = make(tmp_path)
     assert_query_parity(oracle, revived)
     assert revived.vocab.services._names == oracle.vocab.services._names
+
+
+def test_server_periodic_snapshot_bounds_wal(tmp_path):
+    """The server's snapshot loop persists state on a cadence and
+    truncates covered WAL segments — without it the WAL grows without
+    bound (snapshots previously only happened via the manual POST)."""
+    import asyncio
+    import glob as _glob
+
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+
+    async def scenario():
+        storage = make(tmp_path)
+        storage.wal.max_segment_bytes = 64 * 1024  # rotate aggressively
+        server = ZipkinServer(
+            ServerConfig(
+                storage_type="tpu", tpu_snapshot_interval_s=0.3,
+            ),
+            storage=storage,
+        )
+        # start() would bind a real port; drive the loop directly
+        server._snapshot_task = asyncio.create_task(
+            server._snapshot_loop(0.3)
+        )
+        for spans in batches(4):
+            storage.accept(spans).execute()
+        await asyncio.sleep(0.8)  # at least one snapshot fires
+        server._snapshot_task.cancel()
+        try:
+            await server._snapshot_task
+        except asyncio.CancelledError:
+            pass
+        assert (tmp_path / "ckpt" / "meta.json").exists()
+        import json as _json
+
+        meta = _json.load(open(tmp_path / "ckpt" / "meta.json"))
+        assert meta["wal_seq"] > 0
+        # the WAL-bounding half of the loop: every segment fully covered
+        # by the snapshot's wal_seq was deleted — only the live segment
+        # (and at most one covered-but-open predecessor) may remain
+        segs = _glob.glob(str(tmp_path / "wal" / "wal-*.log"))
+        assert len(segs) <= 2, segs
+        return storage
+
+    storage = asyncio.run(scenario())
+    # the snapshot is usable: a fresh boot restores + replays
+    del storage
+    revived = make(tmp_path)
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for spans in batches(4):
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, revived)

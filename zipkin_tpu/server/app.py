@@ -138,6 +138,7 @@ class ZipkinServer:
         self._runner: Optional[web.AppRunner] = None
         self._grpc = None
         self._scribe = None
+        self._snapshot_task = None
 
     # -- app ---------------------------------------------------------------
 
@@ -221,10 +222,42 @@ class ZipkinServer:
             )
             await self._scribe.start()
             self.components["scribe"] = self._scribe
+        if (
+            self.config.tpu_snapshot_interval_s > 0
+            and getattr(self.storage, "checkpoint_dir", None)
+            and hasattr(self.storage, "snapshot")
+        ):
+            # periodic snapshots close the durability loop: they bound
+            # WAL growth (segments covered by a snapshot are deleted)
+            # and bound the replay window after a crash. The reference
+            # has no in-process analog — its durability is the storage
+            # backend's (SURVEY.md §5 checkpoint row).
+            self._snapshot_task = asyncio.create_task(
+                self._snapshot_loop(self.config.tpu_snapshot_interval_s)
+            )
         logger.info("zipkin-tpu listening on :%d", self.config.port)
         return self
 
+    async def _snapshot_loop(self, interval_s: float) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            try:
+                path = await asyncio.to_thread(self.storage.snapshot)
+                logger.info("periodic snapshot -> %s", path)
+            except asyncio.CancelledError:  # pragma: no cover
+                raise
+            except Exception:  # pragma: no cover - keep the loop alive
+                logger.exception("periodic snapshot failed; will retry")
+
     async def stop(self) -> None:
+        take_final_snapshot = self._snapshot_task is not None
+        if self._snapshot_task is not None:
+            self._snapshot_task.cancel()
+            try:
+                await self._snapshot_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._snapshot_task = None
         if self._scribe is not None:
             await self._scribe.stop()
             self._scribe = None
@@ -244,6 +277,15 @@ class ZipkinServer:
                 # and unlinks the shared-memory block
                 await asyncio.to_thread(self._mp_ingester.close)
                 self._mp_ingester = None
+        if take_final_snapshot:
+            # final snapshot LAST: collectors are stopped and the MP
+            # queue drained, so every 202-acked span is in storage —
+            # snapshotting earlier would strand post-snapshot spans in
+            # the WAL (or, without a WAL, lose them)
+            try:
+                await asyncio.to_thread(self.storage.snapshot)
+            except Exception:  # pragma: no cover
+                logger.exception("shutdown snapshot failed")
         self.storage.close()
 
     # -- ingest ------------------------------------------------------------

@@ -45,8 +45,11 @@ class TpuStorage(_CoreTpuStorage):
             pad_to_multiple=min(batch_size, 1024),
             fast_archive_sample=fast_archive_sample,
         )
+        import threading
+
         self.batch_size = batch_size
         self.checkpoint_dir = checkpoint_dir
+        self._snapshot_lock = threading.Lock()
         if checkpoint_dir:
             from zipkin_tpu.tpu.snapshot import maybe_restore
 
@@ -64,7 +67,11 @@ class TpuStorage(_CoreTpuStorage):
 
     def snapshot(self) -> Optional[str]:
         """Persist device sketch state (see tpu/snapshot.py); returns
-        path. WAL segments fully covered by the snapshot are deleted."""
+        path. WAL segments fully covered by the snapshot are deleted.
+        Serialized: a cancelled periodic snapshot's worker thread may
+        still be mid-save when a shutdown snapshot starts — unserialized,
+        their independent state/meta renames could pair a newer state
+        file with an older wal_seq, making the next boot double-replay."""
         if not self.checkpoint_dir:
             return None
         import json
@@ -72,10 +79,11 @@ class TpuStorage(_CoreTpuStorage):
 
         from zipkin_tpu.tpu.snapshot import META_FILE, save
 
-        path = save(self, self.checkpoint_dir)
-        wal = getattr(self, "wal", None)
-        if wal is not None:
-            with open(os.path.join(path, META_FILE)) as f:
-                covered = json.load(f).get("wal_seq", 0)
-            wal.truncate_covered(covered)
+        with self._snapshot_lock:
+            path = save(self, self.checkpoint_dir)
+            wal = getattr(self, "wal", None)
+            if wal is not None:
+                with open(os.path.join(path, META_FILE)) as f:
+                    covered = json.load(f).get("wal_seq", 0)
+                wal.truncate_covered(covered)
         return path
