@@ -253,3 +253,38 @@ def test_server_periodic_snapshot_bounds_wal(tmp_path):
     for spans in batches(4):
         oracle.accept(spans).execute()
     assert_query_parity(oracle, revived)
+
+
+def test_snapshot_races_concurrent_ingest(tmp_path):
+    """Snapshots taken WHILE another thread ingests must stay exact:
+    the device-side clone + wal_seq are captured atomically under the
+    aggregator lock, and the WAL tail replays whatever each snapshot
+    missed — so crash recovery reaches full parity no matter where the
+    snapshots landed relative to the writes (r3: the host pull moved
+    outside the lock so a full-size snapshot no longer stalls ingest)."""
+    import threading
+
+    bs = batches(8)
+    victim = make(tmp_path)
+    errors = []
+
+    def writer():
+        try:
+            for spans in bs:
+                victim.accept(spans).execute()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    for _ in range(4):  # snapshots interleave arbitrarily with writes
+        victim.snapshot()
+    t.join()
+    assert not errors
+    del victim  # crash without a final snapshot
+
+    revived = make(tmp_path)
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for spans in bs:
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, revived)

@@ -324,6 +324,15 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
             in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(),
         )
     )
+    # device-side state clone for snapshots: runs in ms on device, so
+    # the aggregator lock is held only for the dispatch — the host pull
+    # of the copy (~state_bytes over the transport) happens lock-free
+    # while ingest continues against the original buffers
+    snap_copy = jax.jit(
+        lambda s: jax.tree_util.tree_map(jnp.copy, s),
+        out_shardings=sharding,
+    )
+
     def spmd_card(state: AggState):
         from zipkin_tpu.ops import hll as hll_ops
 
@@ -337,7 +346,7 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     return (
         init, step_variants, links, merge, flush, rollup, whist, digest_read,
         edges, edges_rolled, quant_digest, quant_digest_nopend, quant_hist,
-        quant_whist, card, link_ctx, sharding,
+        quant_whist, card, link_ctx, snap_copy, sharding,
     )
 
 
@@ -357,7 +366,7 @@ class ShardedAggregator:
             self._rollup, self._whist, self._digest_read, self._edges,
             self._edges_rolled, self._quant_digest, self._quant_digest_nopend,
             self._quant_hist, self._quant_whist, self._card, self._link_ctx,
-            self._sharding,
+            self._snap_copy, self._sharding,
         ) = _compiled_programs(config, mesh)
         self._step = self._step_variants[(False, False)]
         # device-resident LinkContext for the current write_version (the
@@ -689,9 +698,25 @@ class ShardedAggregator:
             self.write_version += 1
 
     def state_arrays(self) -> list:
-        """Consistent host copy of every state leaf (snapshot path)."""
+        """Consistent host copy of every state leaf (see state_clone)."""
+        clone, _, _ = self.state_clone()
+        return [np.asarray(leaf) for leaf in clone]
+
+    def state_clone(self):
+        """(device clone, wal_seq, host_counters copy), all captured
+        ATOMICALLY under the lock — everything the snapshot records
+        about one instant must come from the same locked section, or a
+        batch ingested during the multi-second host pull would be both
+        inside the recorded counters and after the recorded wal_seq
+        (WAL replay would then double-count it). The lock is held only
+        for the clone DISPATCH (ms); callers pull the clone's leaves
+        lock-free while ingest continues against the live buffers."""
         with self.lock:
-            return [np.asarray(leaf) for leaf in self.state]
+            return (
+                self._snap_copy(self.state),
+                self.wal_seq,
+                dict(self.host_counters),
+            )
 
     def block_until_ready(self) -> None:
         with self.lock:

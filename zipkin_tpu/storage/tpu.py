@@ -80,6 +80,12 @@ class TpuStorage(_CoreTpuStorage):
         from zipkin_tpu.tpu.snapshot import META_FILE, save
 
         with self._snapshot_lock:
+            if self._closed:
+                # an orphaned periodic-snapshot thread can reach here
+                # after shutdown (its asyncio task was cancelled but the
+                # worker thread kept running); close() holds this lock,
+                # so the flag check is race-free
+                return None
             path = save(self, self.checkpoint_dir)
             wal = getattr(self, "wal", None)
             if wal is not None:
@@ -87,3 +93,9 @@ class TpuStorage(_CoreTpuStorage):
                     covered = json.load(f).get("wal_seq", 0)
                 wal.truncate_covered(covered)
         return path
+
+    def close(self) -> None:
+        # serialize with snapshot(): a snapshot mid-flight finishes
+        # before teardown, and any later attempt sees _closed
+        with self._snapshot_lock:
+            super().close()

@@ -43,14 +43,14 @@ SNAPSHOT_VERSION = 2
 def save(store: "TpuStorage", directory: str) -> str:
     """Snapshot sketches + vocab into ``directory`` (atomic). Returns path."""
     os.makedirs(directory, exist_ok=True)
-    # consistent copy under the aggregator lock: concurrent ingest donates
-    # the buffers this would otherwise be reading. wal_seq is read under
-    # the SAME lock so "state + everything after wal_seq" is exact.
-    with store.agg.lock:
-        arrays = {
-            f"f{i}": leaf for i, leaf in enumerate(store.agg.state_arrays())
-        }
-        wal_seq = store.agg.wal_seq
+    # consistency: the state is CLONED on device under the aggregator
+    # lock together with wal_seq AND the host counters (so "state +
+    # counters + everything after wal_seq" describe the same instant),
+    # then pulled to host lock-free — holding the lock through the pull
+    # would stall ingest for the whole transfer (concurrent steps donate
+    # the live buffers, but the clone's are independent).
+    clone, wal_seq, counters = store.agg.state_clone()
+    arrays = {f"f{i}": np.asarray(leaf) for i, leaf in enumerate(clone)}
 
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     with os.fdopen(fd, "wb") as f:  # file object: savez won't append ".npz"
@@ -63,7 +63,10 @@ def save(store: "TpuStorage", directory: str) -> str:
         "wal_seq": wal_seq,
         "n_shards": store.agg.n_shards,
         "config": dataclasses.asdict(store.config),
-        "counters": store.ingest_counters(),
+        # agg counters from the locked capture; vocab-overflow counters
+        # are monotonic, not restored by maybe_restore, and harmless to
+        # read late — so the lock-free merge is safe
+        "counters": {**store.ingest_counters(), **counters},
         "services": store.vocab.services._names,
         "span_names": store.vocab.span_names._names,
         "keys": store.vocab._key_list,
