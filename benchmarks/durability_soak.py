@@ -1,0 +1,134 @@
+"""Kill -9 durability soak: the full crash loop at real scale.
+
+tests/test_wal.py proves WAL/snapshot exactness on the CPU mesh with
+simulated crashes (object teardown). This harness does it for real on
+the chip: a CHILD process ingests at line rate with periodic snapshots,
+the parent SIGKILLs it mid-stream (no cleanup, no atexit — the honest
+crash), then boots a fresh store from checkpoint+WAL and checks that
+every batch the child ACKED (completed ingest call) survived.
+
+Invariant checked: recovered spans >= last acked count, and <= acked +
+one batch (the kill can land between a batch's WAL append and the
+child's ack print — that batch is recoverable but unacked).
+
+Run from the repo root: ``python -m benchmarks.durability_soak``
+(SOAK_SECONDS, SOAK_SNAPSHOT_INTERVAL_S envs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+BATCH = 65_536
+
+_CHILD = r"""
+import os, sys, threading, time
+from tests.fixtures import lots_of_spans
+from zipkin_tpu.model.json_v2 import encode_span_list
+from zipkin_tpu.storage.tpu import TpuStorage
+from zipkin_tpu.tpu.state import AggConfig
+
+state_dir = sys.argv[1]
+snap_interval = float(sys.argv[2])
+small = bool(os.environ.get("SOAK_SMALL"))  # CPU smoke of the harness
+cfg = AggConfig(
+    max_services=64, max_keys=256, hll_precision=8, digest_centroids=16,
+    digest_buffer=1 << 15, ring_capacity=1 << 15, link_buckets=4,
+    hist_slices=2,
+) if small else None
+batch = 16384 if small else 65536
+store = TpuStorage(
+    batch_size=batch, config=cfg,
+    checkpoint_dir=os.path.join(state_dir, "ckpt"),
+    wal_dir=os.path.join(state_dir, "wal"),
+)
+spans = lots_of_spans(2 * batch, seed=7, services=40, span_names=120)
+payloads = [encode_span_list(spans[i:i+batch]) for i in (0, batch)]
+store.warm(payloads[0])
+
+stop = threading.Event()
+def snapper():
+    while not stop.wait(snap_interval):
+        store.snapshot()
+threading.Thread(target=snapper, daemon=True).start()
+
+i = 0
+while True:
+    n, _ = store.ingest_json_fast(payloads[i % 2])
+    i += 1
+    # acked = every completed ingest call (its WAL record is on disk)
+    print(f"ACKED {store.ingest_counters()['spans']}", flush=True)
+"""
+
+
+def main() -> None:
+    soak_s = float(os.environ.get("SOAK_SECONDS", 240))
+    snap_s = float(os.environ.get("SOAK_SNAPSHOT_INTERVAL_S", 60))
+    state_dir = tempfile.mkdtemp(prefix="durability_soak_")
+
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, state_dir, str(snap_s)],
+        stdout=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    acked = 0
+    deadline = time.monotonic() + soak_s
+    try:
+        for line in child.stdout:
+            if line.startswith("ACKED "):
+                acked = int(line.split()[1])
+            if time.monotonic() >= deadline and acked > 0:
+                break
+    finally:
+        os.kill(child.pid, signal.SIGKILL)  # the honest crash: no cleanup
+        child.wait()
+
+    # recovery: fresh process state, same dirs
+    from zipkin_tpu.storage.tpu import TpuStorage
+
+    cfg = None
+    if os.environ.get("SOAK_SMALL"):
+        from zipkin_tpu.tpu.state import AggConfig
+
+        cfg = AggConfig(
+            max_services=64, max_keys=256, hll_precision=8,
+            digest_centroids=16, digest_buffer=1 << 15,
+            ring_capacity=1 << 15, link_buckets=4, hist_slices=2,
+        )
+    t0 = time.perf_counter()
+    revived = TpuStorage(
+        batch_size=BATCH, config=cfg,
+        checkpoint_dir=os.path.join(state_dir, "ckpt"),
+        wal_dir=os.path.join(state_dir, "wal"),
+    )
+    recovery_s = time.perf_counter() - t0
+    recovered = revived.ingest_counters()["spans"]
+    links = revived.get_dependencies(
+        int(time.time() * 1000), 1000 * 86_400_000
+    ).execute()
+    ok = acked <= recovered <= acked + BATCH
+    print(
+        json.dumps(
+            {
+                "artifact": "durability_soak",
+                "acked_spans_at_kill": acked,
+                "recovered_spans": recovered,
+                "bound_ok": ok,
+                "recovery_s": round(recovery_s, 1),
+                "links_after_recovery": len(links),
+                "snapshot_interval_s": snap_s,
+            }
+        ),
+        flush=True,
+    )
+    sys.exit(0 if ok and links else 1)
+
+
+if __name__ == "__main__":
+    main()
