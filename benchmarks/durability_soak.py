@@ -12,37 +12,40 @@ one batch (the kill can land between a batch's WAL append and the
 child's ack print — that batch is recoverable but unacked).
 
 Run from the repo root: ``python -m benchmarks.durability_soak``
-(SOAK_SECONDS, SOAK_SNAPSHOT_INTERVAL_S envs).
+(SOAK_SECONDS, SOAK_SNAPSHOT_INTERVAL_S, SOAK_SMALL envs).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
-BATCH = 65_536
+SMALL_CFG = dict(
+    max_services=64, max_keys=256, hll_precision=8, digest_centroids=16,
+    digest_buffer=1 << 15, ring_capacity=1 << 15, link_buckets=4,
+    hist_slices=2,
+)
 
 _CHILD = r"""
-import os, sys, threading, time
+import json, os, sys
 from tests.fixtures import lots_of_spans
 from zipkin_tpu.model.json_v2 import encode_span_list
 from zipkin_tpu.storage.tpu import TpuStorage
 from zipkin_tpu.tpu.state import AggConfig
+import threading
 
 state_dir = sys.argv[1]
 snap_interval = float(sys.argv[2])
-small = bool(os.environ.get("SOAK_SMALL"))  # CPU smoke of the harness
-cfg = AggConfig(
-    max_services=64, max_keys=256, hll_precision=8, digest_centroids=16,
-    digest_buffer=1 << 15, ring_capacity=1 << 15, link_buckets=4,
-    hist_slices=2,
-) if small else None
-batch = 16384 if small else 65536
+cfg_json = sys.argv[3]  # one source of truth: the parent's config
+batch = int(sys.argv[4])
+cfg = AggConfig(**json.loads(cfg_json)) if cfg_json != "null" else None
 store = TpuStorage(
     batch_size=batch, config=cfg,
     checkpoint_dir=os.path.join(state_dir, "ckpt"),
@@ -60,7 +63,10 @@ threading.Thread(target=snapper, daemon=True).start()
 
 i = 0
 while True:
-    n, _ = store.ingest_json_fast(payloads[i % 2])
+    result = store.ingest_json_fast(payloads[i % 2])
+    if result is None:  # native parser unavailable: object path
+        from zipkin_tpu.model import codec
+        store.accept(codec.decode_spans(payloads[i % 2])).execute()
     i += 1
     # acked = every completed ingest call (its WAL record is on disk)
     print(f"ACKED {store.ingest_counters()['spans']}", flush=True)
@@ -70,40 +76,61 @@ while True:
 def main() -> None:
     soak_s = float(os.environ.get("SOAK_SECONDS", 240))
     snap_s = float(os.environ.get("SOAK_SNAPSHOT_INTERVAL_S", 60))
+    small = bool(os.environ.get("SOAK_SMALL"))
+    batch = 16384 if small else 65536
+    cfg_json = json.dumps(SMALL_CFG) if small else "null"
     state_dir = tempfile.mkdtemp(prefix="durability_soak_")
 
     child = subprocess.Popen(
-        [sys.executable, "-c", _CHILD, state_dir, str(snap_s)],
+        [sys.executable, "-c", _CHILD, state_dir, str(snap_s), cfg_json,
+         str(batch)],
         stdout=subprocess.PIPE, text=True,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
-    acked = 0
-    deadline = time.monotonic() + soak_s
-    try:
+
+    # reader thread: the deadline must fire even if the child stalls
+    # without printing (a blocking `for line in stdout` would hang)
+    acks = [0]
+    eof = threading.Event()
+
+    def reader():
         for line in child.stdout:
             if line.startswith("ACKED "):
-                acked = int(line.split()[1])
-            if time.monotonic() >= deadline and acked > 0:
-                break
-    finally:
-        os.kill(child.pid, signal.SIGKILL)  # the honest crash: no cleanup
-        child.wait()
+                acks[0] = int(line.split()[1])
+        eof.set()
 
-    # recovery: fresh process state, same dirs
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.monotonic() + soak_s
+    while time.monotonic() < deadline or acks[0] == 0:
+        if eof.is_set() or child.poll() is not None:
+            break
+        time.sleep(0.5)
+
+    # the kill must be OURS: a child that died on its own is not a
+    # kill-9 soak, whatever the recovery numbers say
+    child_was_alive = child.poll() is None
+    os.kill(child.pid, signal.SIGKILL)  # the honest crash: no cleanup
+    child.wait()
+    t.join(timeout=10)  # drain buffered ACKED lines to EOF
+    acked = acks[0]
+    if not child_was_alive or acked == 0:
+        print(json.dumps({
+            "artifact": "durability_soak", "bound_ok": False,
+            "error": "child exited on its own before the kill"
+            if not child_was_alive else "child never acked a batch",
+            "child_returncode": child.returncode,
+        }), flush=True)
+        sys.exit(1)
+
+    # recovery: fresh process state, same dirs, same config source
     from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
 
-    cfg = None
-    if os.environ.get("SOAK_SMALL"):
-        from zipkin_tpu.tpu.state import AggConfig
-
-        cfg = AggConfig(
-            max_services=64, max_keys=256, hll_precision=8,
-            digest_centroids=16, digest_buffer=1 << 15,
-            ring_capacity=1 << 15, link_buckets=4, hist_slices=2,
-        )
+    cfg = AggConfig(**SMALL_CFG) if small else None
     t0 = time.perf_counter()
     revived = TpuStorage(
-        batch_size=BATCH, config=cfg,
+        batch_size=batch, config=cfg,
         checkpoint_dir=os.path.join(state_dir, "ckpt"),
         wal_dir=os.path.join(state_dir, "wal"),
     )
@@ -112,7 +139,7 @@ def main() -> None:
     links = revived.get_dependencies(
         int(time.time() * 1000), 1000 * 86_400_000
     ).execute()
-    ok = acked <= recovered <= acked + BATCH
+    ok = acked <= recovered <= acked + batch
     print(
         json.dumps(
             {
@@ -127,7 +154,10 @@ def main() -> None:
         ),
         flush=True,
     )
-    sys.exit(0 if ok and links else 1)
+    if ok and links:
+        shutil.rmtree(state_dir, ignore_errors=True)  # keep only on failure
+        sys.exit(0)
+    sys.exit(1)
 
 
 if __name__ == "__main__":
