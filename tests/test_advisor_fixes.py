@@ -207,3 +207,45 @@ class TestSnapshotVersioning:
             __import__("dataclasses").asdict(store.config)))
         json.dump(meta, open(meta_path, "w"))
         assert snapshot.maybe_restore(store, d)
+
+
+class TestWalLifecycle:
+    """Round-3 advisor findings: the fsync knob must reach the WAL, and
+    TpuStorage.close() must close the live segment + detach the hook."""
+
+    def _store(self, tmp_path, **kw):
+        from zipkin_tpu.storage.tpu import TpuStorage
+        from zipkin_tpu.tpu.state import AggConfig
+
+        cfg = AggConfig(
+            max_services=16, max_keys=64, hll_precision=6,
+            digest_centroids=8, digest_buffer=256, ring_capacity=512,
+            link_buckets=2, bucket_minutes=60, hist_slices=2,
+        )
+        return TpuStorage(
+            config=cfg, batch_size=64, num_devices=1,
+            wal_dir=str(tmp_path / "wal"), **kw,
+        )
+
+    def test_wal_fsync_knob_propagates(self, tmp_path):
+        assert self._store(tmp_path).wal.fsync is False
+        assert self._store(tmp_path, wal_fsync=True).wal.fsync is True
+
+    def test_close_closes_wal_and_detaches_hook(self, tmp_path):
+        from tests.fixtures import lots_of_spans
+
+        store = self._store(tmp_path)
+        store.accept(lots_of_spans(32, seed=3)).execute()
+        wal = store.wal
+        assert wal._fh is not None
+        store.close()
+        assert wal._fh is None, "close() must close the live WAL segment"
+        assert store.agg.wal_hook is None, "close() must detach the hook"
+
+    def test_wal_fsync_env_wiring(self, monkeypatch):
+        from zipkin_tpu.server.config import ServerConfig
+
+        monkeypatch.setenv("TPU_WAL_FSYNC", "true")
+        assert ServerConfig.from_env().tpu_wal_fsync is True
+        monkeypatch.delenv("TPU_WAL_FSYNC")
+        assert ServerConfig.from_env().tpu_wal_fsync is False

@@ -206,6 +206,83 @@ def test_fallback_payload_takes_object_path():
     )
 
 
+def test_multichunk_payload_drains_completely():
+    """A payload larger than store.max_batch splits into chunks; drain()
+    must cover the LAST chunk, not return after the first (ADVICE r3:
+    completion used to be signaled on the first chunk, so drain-then-
+    verify callers could observe missing spans)."""
+    spans = lots_of_spans(10_000, seed=7, services=8, span_names=16)
+    payload = encode_span_list(spans)
+    sync = make_store()
+    assert sync.max_batch == 4096  # 3 chunks — the path under test
+    ingest_sync(sync, [payload])
+    mp_store = make_store()
+    ing = ingest_mp(mp_store, [payload], workers=1)
+    assert ing.counters["fallbacks"] == 0
+    # the whole point: immediately after drain(), EVERY chunk's spans
+    # are on the device, not just the first 4096
+    assert mp_store.agg.host_counters["spans"] == 10_000
+    assert_state_parity(sync, mp_store, exact_digest=True)
+
+
+def test_dead_worker_surfaces_error_instead_of_wedging():
+    """If a worker dies uncleanly (segfault/OOM), drain()/submit() must
+    raise instead of blocking forever on inflight counts the worker will
+    never complete (ADVICE r3: server shutdown used to wedge)."""
+    import time
+
+    from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
+
+    mp_store = make_store()
+    ing = MultiProcessIngester(mp_store, workers=1)
+    try:
+        # simulate an OOM-kill: SIGKILL, no EOF message ever sent
+        ing._procs[0].kill()
+        deadline = time.monotonic() + 30
+        while ing._dispatch_error is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ing._dispatch_error is not None, "dead worker never detected"
+        with pytest.raises(RuntimeError):
+            ing.submit(payloads(1)[0])
+        with pytest.raises(RuntimeError):
+            ing.drain()
+    finally:
+        ing.close()  # must not hang either
+
+
+def test_dead_worker_does_not_wedge_survivors():
+    """workers=2 under traffic, one killed: the dispatcher's sink mode
+    must keep releasing shm slots so the SURVIVING worker never blocks
+    in slot_sem.acquire(), and close() returns promptly instead of
+    burning its 30 s join timeout and terminating a healthy worker."""
+    import time
+
+    from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
+
+    mp_store = make_store()
+    ps = payloads(n_payloads=6, spans_each=1024)
+    ing = MultiProcessIngester(mp_store, workers=2, queue_depth=16)
+    try:
+        for p in ps[:3]:
+            ing.submit(p)
+        ing._procs[0].kill()
+        # keep traffic flowing at the survivor while the reap runs
+        for p in ps[3:]:
+            try:
+                ing.submit(p)
+            except RuntimeError:
+                break
+        deadline = time.monotonic() + 30
+        while ing._dispatch_error is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ing._dispatch_error is not None
+    finally:
+        t0 = time.monotonic()
+        ing.close()
+        # survivor must have exited via its sentinel, not terminate()
+        assert time.monotonic() - t0 < 25, "close() wedged on survivor"
+
+
 def test_sampler_parity():
     """Boundary sampling must drop the same traces in both tiers."""
     from zipkin_tpu.collector.core import CollectorSampler

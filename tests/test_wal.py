@@ -288,3 +288,19 @@ def test_snapshot_races_concurrent_ingest(tmp_path):
     for spans in bs:
         oracle.accept(spans).execute()
     assert_query_parity(oracle, revived)
+
+
+def test_append_after_close_raises(tmp_path):
+    """A hook captured by a racing thread before close() detached it must
+    FAIL on append, not silently reopen the segment and log a batch past
+    the final snapshot (double-replay on next boot)."""
+    import pytest
+
+    from zipkin_tpu.tpu.wal import WriteAheadLog
+
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    wal.append(np.zeros((1, 2, 4), np.uint32), {"n_spans": 0})
+    wal.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        wal.append(np.zeros((1, 2, 4), np.uint32), {"n_spans": 0})
+    wal.close()  # idempotent

@@ -71,6 +71,7 @@ def build_storage(config: ServerConfig) -> StorageComponent:
             num_devices=config.tpu_devices,
             checkpoint_dir=config.tpu_checkpoint_dir,
             wal_dir=config.tpu_wal_dir,
+            wal_fsync=config.tpu_wal_fsync,
             config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
             fast_archive_sample=config.tpu_fast_archive_sample,
             **common,

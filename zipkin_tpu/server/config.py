@@ -69,6 +69,10 @@ class ServerConfig:
     tpu_mp_workers: int = 0  # >0: multi-process parse tier (mp_ingest)
     tpu_checkpoint_dir: Optional[str] = None
     tpu_wal_dir: Optional[str] = None  # append-log of fused batches (tpu/wal.py)
+    # fsync each WAL append: durability vs host/power failure, at a
+    # per-batch fsync cost. Off = page-cache durability (process crash
+    # only — the kill -9 soak's boundary; see ARCHITECTURE.md).
+    tpu_wal_fsync: bool = False
     # periodic snapshot cadence (bounds WAL growth + crash-replay
     # window); active only when a checkpoint dir is configured. 0 = off.
     tpu_snapshot_interval_s: float = 300.0
@@ -105,6 +109,7 @@ class ServerConfig:
             tpu_mp_workers=_env_int("TPU_MP_WORKERS", 0),
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
             tpu_wal_dir=os.environ.get("TPU_WAL_DIR") or None,
+            tpu_wal_fsync=_env_bool("TPU_WAL_FSYNC", False),
             tpu_snapshot_interval_s=_env_float("TPU_SNAPSHOT_INTERVAL_S", 300.0),
             tpu_agg=_env_agg(),
         )

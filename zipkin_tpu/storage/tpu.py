@@ -29,6 +29,7 @@ class TpuStorage(_CoreTpuStorage):
         autocomplete_keys: Sequence[str] = (),
         fast_archive_sample: int = 64,
         wal_dir: Optional[str] = None,
+        wal_fsync: bool = False,
     ) -> None:
         mesh = None
         if num_devices is not None:
@@ -61,7 +62,12 @@ class TpuStorage(_CoreTpuStorage):
             # with delta cursors at the post-replay vocab state
             from zipkin_tpu.tpu import wal as wal_mod
 
-            wal = wal_mod.WriteAheadLog(wal_dir)
+            # fsync=False bounds durability at process crash (acked
+            # batches sit in the OS page cache until the kernel flushes);
+            # TPU_WAL_FSYNC=true extends it to host/power failure at a
+            # per-append fsync cost — see ARCHITECTURE.md "durability
+            # plane" for the boundary statement
+            wal = wal_mod.WriteAheadLog(wal_dir, fsync=wal_fsync)
             wal_mod.replay(self, wal, from_seq=self.agg.wal_seq)
             wal_mod.attach(self, wal)
 
@@ -98,4 +104,10 @@ class TpuStorage(_CoreTpuStorage):
         # serialize with snapshot(): a snapshot mid-flight finishes
         # before teardown, and any later attempt sees _closed
         with self._snapshot_lock:
+            wal = getattr(self, "wal", None)
+            if wal is not None:
+                # detach the hook before closing the segment, or a
+                # reused aggregator could append to a closed file
+                self.agg.wal_hook = None
+                wal.close()
             super().close()

@@ -95,7 +95,7 @@ def _worker_main(
     sent_svc, sent_name, sent_pair = 1, 1, 1
     slot_ids = itertools.cycle(range(n_slots))
 
-    def handle(payload: bytes, emitted: list) -> None:
+    def handle(payload: bytes, state: dict) -> None:
         nonlocal sent_svc, sent_name, sent_pair
         parsed = (
             native.parse_spans(payload, nvocab=nvocab)
@@ -105,7 +105,7 @@ def _worker_main(
         if parsed is None:
             # the strict-codec fallback needs Span objects: punt the
             # raw payload back to the dispatcher's slow path
-            emitted.append(True)
+            state["completed"] = True
             result_q.put((_KIND_FALLBACK, widx, payload))
             return
         nvocab.sync()
@@ -122,7 +122,7 @@ def _worker_main(
                         setattr(parsed, field, col[:n][idx])
                 parsed.n = n = len(idx)
         if n == 0:
-            emitted.append(True)
+            state["completed"] = True
             result_q.put(
                 (_KIND_BATCH, widx, None, None, 0, 0, 0, dropped,
                  [], [], [], [], (0, 0))
@@ -162,17 +162,23 @@ def _worker_main(
                 if live_ts.size
                 else (0, 0)
             )
-            emitted.append(True)
+            # -1 marks a continuation chunk: the dispatcher decrements
+            # inflight once per PAYLOAD, on the LAST chunk's message —
+            # not the first, or drain() could return while later chunks
+            # are still queued/being packed and miss spans the caller
+            # was promised (ADVICE r3). The sampled-drop count rides the
+            # completion chunk.
+            is_last = hi == n
+            state["shipped"] = True
+            if is_last:
+                state["completed"] = True
             result_q.put(
                 (
                     _KIND_BATCH, widx, slot, fused.shape,
                     int(cols.valid.sum()),
                     int((cols.valid & cols.has_dur).sum()),
                     int((cols.valid & cols.err).sum()),
-                    # -1 marks a continuation chunk: the dispatcher
-                    # decrements inflight once per PAYLOAD, on the
-                    # first-chunk message (dropped >= 0)
-                    dropped if lo == 0 else -1,
+                    dropped if is_last else -1,
                     svc_new, name_new, pairs_new, arch, ts_range,
                 )
             )
@@ -182,20 +188,29 @@ def _worker_main(
             item = work_q.get()
             if item is None:
                 break
-            emitted: list = []
+            state: dict = {"completed": False}
             try:
-                handle(item, emitted)
+                handle(item, state)
             except Exception:  # pragma: no cover - keep the pool alive
                 logging.getLogger(__name__).exception(
                     "mp-ingest worker %d failed on a payload", widx
                 )
-                if not emitted:
-                    # nothing reached the dispatcher: whole payload takes
-                    # the slow path
-                    result_q.put((_KIND_FALLBACK, widx, item))
-                # else: the payload's first chunk already shipped (and
-                # will decrement inflight); remaining chunks are lost —
-                # logged above, bounded to one payload
+                if not state["completed"]:
+                    if not state.get("shipped"):
+                        # nothing reached the dispatcher: whole payload
+                        # retries on the slow path
+                        result_q.put((_KIND_FALLBACK, widx, item))
+                    else:
+                        # some chunks shipped without the completion
+                        # marker — ship an empty completion record so
+                        # inflight still decrements and drain() cannot
+                        # hang. A fallback retry here would double-ingest
+                        # the shipped chunks; the un-shipped tail is lost
+                        # instead — logged above, bounded to one payload.
+                        result_q.put(
+                            (_KIND_BATCH, widx, None, None, 0, 0, 0, 0,
+                             [], [], [], [], (0, 0))
+                        )
     finally:
         result_q.put((_KIND_EOF, widx))
         shm.close()
@@ -323,7 +338,23 @@ class MultiProcessIngester:
             return
         self._closed = True
         for _ in self._procs:
-            self._work_q.put(None)
+            # the work queue is bounded: with every worker dead (OOM
+            # storm) and the queue full of acked payloads, a plain
+            # put(None) would block forever. Only force space when
+            # nothing can be consuming — a slow-but-alive pool keeps
+            # its payloads.
+            while True:
+                try:
+                    self._work_q.put(None, timeout=0.5)
+                    break
+                except queue.Full:
+                    if self._dispatch_error is not None or not any(
+                        p.is_alive() for p in self._procs
+                    ):
+                        try:
+                            self._work_q.get_nowait()
+                        except queue.Empty:
+                            pass
         for p in self._procs:
             p.join(timeout=30)
             if p.is_alive():  # pragma: no cover - hang safety
@@ -340,80 +371,195 @@ class MultiProcessIngester:
     def _dispatch_loop(self) -> None:
         try:
             self._run_dispatch()
-        except BaseException as e:  # pragma: no cover - surfaced to callers
+        except BaseException as e:
             logger.exception("mp-ingest dispatcher failed")
             self._dispatch_error = e
             with self._cv:
                 self._cv.notify_all()
+            self._sink_until_closed()
+
+    def _sink_until_closed(self) -> None:
+        """After a dispatcher failure, keep draining result_q and
+        releasing shm slots so SURVIVING workers never wedge in
+        slot_sem.acquire() with the only release site (the normal
+        dispatch loop) gone — otherwise close() would burn its full join
+        timeout per live worker and terminate() it mid-payload. Results
+        are discarded: the error is already surfaced to submit()/drain(),
+        so callers know batches after the failure point are lost."""
+        while True:
+            try:
+                msg = self._result_q.get(timeout=0.25)
+            except queue.Empty:
+                if self._closed and not any(p.is_alive() for p in self._procs):
+                    return
+                continue
+            if msg[0] == _KIND_BATCH and msg[2] is not None:
+                self._sems[msg[1]].release()
 
     def _run_dispatch(self) -> None:
-        store = self.store
-        vocab = store.vocab
+        import time
+
         maps = [_IdMaps() for _ in range(self.workers)]
-        eofs = 0
-        while eofs < self.workers:
+        eof_set: set = set()
+        last_liveness = time.monotonic()
+        while len(eof_set) < self.workers:
             try:
                 msg = self._result_q.get(timeout=0.5)
             except queue.Empty:
                 if self._closed and not any(p.is_alive() for p in self._procs):
                     break
+                if not self._closed:
+                    self._check_liveness(maps, eof_set)
+                    last_liveness = time.monotonic()
                 continue
-            kind = msg[0]
-            if kind == _KIND_EOF:
-                eofs += 1
-                continue
-            if kind == _KIND_FALLBACK:
-                _, widx, payload = msg
-                self._fallback(payload)
-                self.counters["fallbacks"] += 1
-                self._done_one()
-                continue
-            (
-                _, widx, slot, shape, n_spans, n_dur, n_err, dropped,
-                svc_new, name_new, pairs_new, arch, ts_range,
-            ) = msg
-            m = maps[widx]
-            if svc_new or name_new or pairs_new:
-                with store._intern_lock:
-                    m.svc = _IdMaps._append(
-                        m.svc, [vocab.services.intern(s) for s in svc_new]
-                    )
-                    m.name = _IdMaps._append(
-                        m.name, [vocab.span_names.intern(s) for s in name_new]
-                    )
-                    m.key = _IdMaps._append(
-                        m.key,
-                        [
-                            vocab.key_id(int(m.svc[sl]), int(m.name[nl]))
-                            for sl, nl in pairs_new
-                        ],
-                    )
-            if slot is not None:
-                size = int(np.prod(shape))
-                src = np.frombuffer(
-                    self._shm.buf, np.uint32, count=size,
-                    offset=widx * self._slots_per_worker * self._slot_bytes
-                    + slot * self._slot_bytes,
+            self._handle_msg(msg, maps, eof_set)
+            # liveness must ALSO run under sustained traffic: a busy
+            # surviving worker keeps result_q non-empty, so the idle
+            # branch alone could leave a dead worker's acked payloads
+            # pinning _inflight for as long as load lasts
+            if (
+                not self._closed
+                and time.monotonic() - last_liveness > 2.0
+            ):
+                self._check_liveness(maps, eof_set)
+                last_liveness = time.monotonic()
+
+    def _check_liveness(self, maps: List[_IdMaps], eof_set: set) -> None:
+        """A worker that died uncleanly (segfault in the native parser,
+        OOM kill) never sends EOF: without this check its inflight
+        payloads would pin _inflight > 0 and drain()/stop() would wedge
+        forever (ADVICE r3)."""
+        dead = [
+            w
+            for w, p in enumerate(self._procs)
+            if not p.is_alive() and w not in eof_set
+        ]
+        if dead:
+            self._reap_dead_workers(dead, maps, eof_set)
+
+    def _reap_dead_workers(
+        self, dead: List[int], maps: List[_IdMaps], eof_set: set
+    ) -> None:
+        """A worker died without EOF. Recover what is recoverable, then
+        surface a dispatcher error: results it already produced are
+        applied, payloads still in the work queue re-dispatch on the
+        slow path, but the payload it was processing is unaccountable
+        (its chunk count is unknown), so drain() must raise rather than
+        guess."""
+        # timeout-based drains, not get_nowait(): mp.Queue puts go
+        # through a feeder thread, so a just-submitted payload can be
+        # in the pipe but not yet visible — get_nowait() would miss it
+        # and silently lose a 202-acked payload
+        while True:  # apply results already produced (any worker)
+            try:
+                msg = self._result_q.get(timeout=0.25)
+            except queue.Empty:
+                break
+            self._handle_msg(msg, maps, eof_set)
+        salvaged = 0
+        # stop salvaging the moment close() starts: its shutdown
+        # sentinels must reach the surviving workers, not this loop
+        while not self._closed:  # payloads no dead worker will pick up
+            try:
+                payload = self._work_q.get(timeout=0.25)
+            except queue.Empty:
+                break
+            if payload is None:
+                # a concurrent close() raced us: try to hand the
+                # sentinel back. put_nowait, never a blocking put — the
+                # queue may have refilled, and blocking here would
+                # deadlock shutdown. Dropping it on Full is safe by
+                # COUNTING, not by any re-put mechanism: close() puts N
+                # sentinels, this reap runs once per dispatcher lifetime
+                # (it ends in raise) so at most 1 sentinel is dropped,
+                # and >=1 worker is dead — N-1 sentinels still cover the
+                # <=N-1 survivors. If reaping ever becomes repeatable,
+                # this argument breaks and sentinels must be re-counted.
+                try:
+                    self._work_q.put_nowait(payload)
+                except queue.Full:
+                    pass
+                break
+            self._fallback(payload)
+            self.counters["fallbacks"] += 1
+            self._done_one()
+            salvaged += 1
+        with self._cv:
+            unaccounted = self._inflight
+        raise RuntimeError(
+            f"mp-ingest worker(s) {dead} died uncleanly; "
+            f"{salvaged} queued payload(s) salvaged via the slow path, "
+            f"{unaccounted} acked payload(s) unaccounted (in-process at "
+            "failure or raced by surviving workers) — restart the ingester"
+        )
+
+    def _handle_msg(self, msg, maps: List[_IdMaps], eof_set: set) -> None:
+        store = self.store
+        vocab = store.vocab
+        kind = msg[0]
+        if kind == _KIND_EOF:
+            eof_set.add(msg[1])
+            if not self._closed:
+                # workers only EOF after close()'s None sentinel; an EOF
+                # before close() means the worker loop was torn down by
+                # a BaseException (KeyboardInterrupt, a failing
+                # work_q.get) with its inflight payloads unaccounted —
+                # without this, drain() would wedge with no error and
+                # the liveness check would skip it (it IS in eof_set)
+                self._reap_dead_workers([msg[1]], maps, eof_set)
+            return
+        if kind == _KIND_FALLBACK:
+            _, widx, payload = msg
+            self._fallback(payload)
+            self.counters["fallbacks"] += 1
+            self._done_one()
+            return
+        (
+            _, widx, slot, shape, n_spans, n_dur, n_err, dropped,
+            svc_new, name_new, pairs_new, arch, ts_range,
+        ) = msg
+        m = maps[widx]
+        if svc_new or name_new or pairs_new:
+            with store._intern_lock:
+                m.svc = _IdMaps._append(
+                    m.svc, [vocab.services.intern(s) for s in svc_new]
                 )
-                fused = src.reshape(shape).copy()
-                self._sems[widx].release()  # slot free the moment we copied
-                self._remap(fused, m)
-                if arch:
-                    self._archive(arch)
-                store.agg.ingest_fused(
-                    fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
-                    ts_range=ts_range,
+                m.name = _IdMaps._append(
+                    m.name, [vocab.span_names.intern(s) for s in name_new]
                 )
-                self.counters["accepted"] += n_spans
-            self.counters["sampleDropped"] += max(dropped, 0)
-            if self.metrics is not None:
-                self.metrics.increment_spans(n_spans + max(dropped, 0))
-                if dropped > 0:
-                    self.metrics.increment_spans_dropped(dropped)
-            # dropped == -1 marks a continuation chunk; inflight
-            # decrements once per payload, on its first-chunk message
-            if dropped >= 0:
-                self._done_one()
+                m.key = _IdMaps._append(
+                    m.key,
+                    [
+                        vocab.key_id(int(m.svc[sl]), int(m.name[nl]))
+                        for sl, nl in pairs_new
+                    ],
+                )
+        if slot is not None:
+            size = int(np.prod(shape))
+            src = np.frombuffer(
+                self._shm.buf, np.uint32, count=size,
+                offset=widx * self._slots_per_worker * self._slot_bytes
+                + slot * self._slot_bytes,
+            )
+            fused = src.reshape(shape).copy()
+            self._sems[widx].release()  # slot free the moment we copied
+            self._remap(fused, m)
+            if arch:
+                self._archive(arch)
+            store.agg.ingest_fused(
+                fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
+                ts_range=ts_range,
+            )
+            self.counters["accepted"] += n_spans
+        self.counters["sampleDropped"] += max(dropped, 0)
+        if self.metrics is not None:
+            self.metrics.increment_spans(n_spans + max(dropped, 0))
+            if dropped > 0:
+                self.metrics.increment_spans_dropped(dropped)
+        # dropped == -1 marks a continuation chunk; inflight
+        # decrements once per payload, on its LAST chunk's message
+        if dropped >= 0:
+            self._done_one()
 
     def _done_one(self) -> None:
         with self._cv:

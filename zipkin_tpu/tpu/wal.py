@@ -59,6 +59,7 @@ class WriteAheadLog:
         self._fh_bytes = 0
         self._seg_idx = 0
         self._seq = 0
+        self._closed = False
         # resume numbering after the existing records
         for seq, _, _ in self.records():
             self._seq = max(self._seq, seq)
@@ -71,6 +72,12 @@ class WriteAheadLog:
     def append(self, fused: np.ndarray, meta: dict) -> int:
         """Append one batch; returns its sequence number. ``meta`` must
         be JSON-serializable; shape/dtype are recorded automatically."""
+        if self._closed:
+            # without this, a hook captured by a racing ingest thread
+            # before close() detached it would silently REOPEN the
+            # segment via _file_for and log a batch after the final
+            # snapshot — double-replay on next boot (r3 review finding)
+            raise RuntimeError("WAL is closed")
         self._seq += 1
         payload = np.ascontiguousarray(fused, np.uint32).tobytes()
         meta = dict(meta, shape=list(fused.shape))
@@ -199,6 +206,7 @@ class WriteAheadLog:
                 logger.info("WAL segment %s truncated (<= %d)", path, covered_seq)
 
     def close(self) -> None:
+        self._closed = True
         if self._fh is not None:
             self._fh.close()
             self._fh = None
