@@ -1,0 +1,120 @@
+"""Chip measurement of the r4 fresh-dependency-read path.
+
+Full-size AggConfig (ring 2^18), single real chip: fill the ring past
+several wraps through the production ingest step (which now maintains
+the union-sort permutation per batch), then XPlane-capture
+
+- ``spmd_edges_fresh`` — the ONE-dispatch first-query-after-write read
+  that gates the 50 ms SLO with no amortized exclusions;
+- the fused ingest step — to price the per-batch merge maintenance the
+  permutation costs;
+- ``spmd_rollup`` — which inherited the maintained order (its internal
+  full-ring lexsort is gone).
+
+Run from the repo root on the chip: ``python -m benchmarks.fresh_read_chip``.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.parallel.mesh import make_mesh
+    from zipkin_tpu.parallel.sharded import ShardedAggregator
+    from zipkin_tpu.tpu.columnar import Vocab, pack_spans
+    from zipkin_tpu.tpu.state import AggConfig
+
+    config = AggConfig()
+    agg = ShardedAggregator(config, make_mesh(1))
+    vocab = Vocab(config.max_services, config.max_keys)
+    batch = 65_536
+    spans = lots_of_spans(batch, seed=7, services=40, span_names=120)
+    cols = pack_spans(spans, vocab, pad_to_multiple=batch)
+
+    t0 = time.perf_counter()
+    agg.warm_programs(cols)
+    warm_s = time.perf_counter() - t0
+
+    # fill past one full ring wrap (ring 262k, batch 64k -> 8 batches
+    # covers 2 wraps); timestamps advance so windows are realistic
+    t0 = time.perf_counter()
+    steps = 12
+    for i in range(steps):
+        agg.ingest(cols)
+    agg.block_until_ready()
+    ingest_wall = time.perf_counter() - t0
+
+    lo_min, hi_min = 0, 1 << 30
+
+    def fresh_read():
+        with agg.lock:
+            agg._ctx_cache = (-1, None)
+        agg.dependency_edges(lo_min, hi_min)
+
+    def cached_read():
+        agg.dependency_edges(lo_min, hi_min)
+
+    fresh_read()  # compile
+    cached_read()
+    walls = {"fresh": [], "cached": []}
+    for _ in range(8):
+        t1 = time.perf_counter()
+        fresh_read()
+        walls["fresh"].append((time.perf_counter() - t1) * 1e3)
+        t1 = time.perf_counter()
+        cached_read()
+        walls["cached"].append((time.perf_counter() - t1) * 1e3)
+
+    device = {}
+    program_ms = {}
+    try:
+        from benchmarks.xplane_tools import device_op_totals, latest_xspace
+
+        trace_dir = tempfile.mkdtemp(prefix="fresh_read_")
+        with jax.profiler.trace(trace_dir):
+            for _ in range(3):
+                agg.ingest(cols)
+            fresh_read()
+            cached_read()
+            agg.rollup_now()
+            agg.block_until_ready()
+        space = latest_xspace(trace_dir)
+        totals = device_op_totals(space)
+        for op, (us, n) in sorted(
+            totals.items(), key=lambda kv: -kv[1][0]
+        )[:14]:
+            device[op] = {"total_ms": round(us / 1e3, 3), "count": n}
+        for op, (us, n) in totals.items():
+            if op.startswith("jit_"):
+                name = op.split("(")[0][len("jit_"):]
+                program_ms[name] = round(
+                    max(program_ms.get(name, 0.0), us / 1e3 / max(n, 1)), 3
+                )
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    except Exception as e:  # pragma: no cover
+        device = {"error": str(e)}
+
+    med = lambda xs: round(sorted(xs)[len(xs) // 2], 1)
+    print(json.dumps({
+        "artifact": "fresh_read_chip",
+        "ring_capacity": config.ring_capacity,
+        "warm_s": round(warm_s, 1),
+        "ingest_spans_per_sec_wall": round(steps * batch / ingest_wall),
+        "fresh_read_wall_ms_p50": med(walls["fresh"]),
+        "cached_read_wall_ms_p50": med(walls["cached"]),
+        "program_device_ms": program_ms,
+        "device_ops_ms": device,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

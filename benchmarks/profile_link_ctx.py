@@ -1,0 +1,213 @@
+"""Cost breakdown of the link-context rebuild (VERDICT r3 order 1).
+
+The r3 SLO capture showed ``spmd_link_ctx`` at 145.8 ms captured device
+time — 3x the 50 ms query SLO — so a FRESH dependency read (first query
+after a write) cannot yet gate without amortized exclusions. Before
+redesigning, this harness attributes that time to the program's parts at
+full-size state (ring_capacity = 2^18):
+
+- the 4-key union lexsort over 2R lanes (resolve_parents);
+- the two pointer-doubling chases (nearest_rpc_ancestor, reaches_root),
+  19 fixed passes each at this R;
+- a fixed-point (lax.while_loop) variant of the same chases that stops
+  at convergence — trace forests are shallow (depth <= tens), so the
+  fixed ceil(log2(R)) schedule wastes most of its passes;
+- the residual (segment run ops, scatters, rule selects).
+
+Run from the repo root on the chip: ``python -m benchmarks.profile_link_ctx``.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import time
+
+
+def synthetic_ring(r: int, seed: int = 7):
+    """Host-side ring columns shaped like real traffic: ~8-span traces,
+    client/server shared pairs, 40 services, occasional deep chains."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_traces = max(r // 8, 1)
+    trace_of = rng.integers(0, n_traces, r).astype(np.uint32)
+    # span ids unique per lane; parents point at a lane of the same trace
+    # with a lower lane index (plus some dangling/missing parents)
+    s0 = np.arange(1, r + 1, dtype=np.uint32)
+    s1 = rng.integers(0, 1 << 32, r, dtype=np.uint32)
+    p0 = np.zeros(r, np.uint32)
+    p1 = np.zeros(r, np.uint32)
+    # build parent pointers: for each lane, pick an earlier lane in a
+    # window of 16 as parent ~80% of the time
+    back = rng.integers(1, 16, r)
+    parent_lane = np.arange(r) - back
+    has_par = (parent_lane >= 0) & (rng.random(r) < 0.8)
+    # force same trace id as parent so joins actually hit
+    trace_of[has_par] = trace_of[parent_lane[has_par]]
+    p0[has_par] = s0[parent_lane[has_par]]
+    p1[has_par] = s1[parent_lane[has_par]]
+    kind = rng.integers(0, 5, r).astype(np.int32)
+    svc = rng.integers(1, 40, r).astype(np.int32)
+    return dict(
+        trace_h=trace_of, tl0=trace_of ^ 0x9E3779B9, tl1=trace_of * 3,
+        s0=s0, s1=s1, p0=p0, p1=p1,
+        shared=(rng.random(r) < 0.15),
+        kind=kind, svc=svc, rsvc=rng.integers(0, 40, r).astype(np.int32),
+        err=(rng.random(r) < 0.05),
+        valid=np.ones(r, bool),
+        seq=np.arange(r, dtype=np.int32),
+    )
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from zipkin_tpu.ops import linker
+    from zipkin_tpu.ops.segments import segment_starts
+
+    r = 1 << 18
+    cols = synthetic_ring(r)
+    x = linker.LinkInput(**{k: jnp.asarray(v) for k, v in cols.items()})
+    x = jax.device_put(x)
+
+    pieces = {}
+
+    # -- full current program -------------------------------------------
+    full = jax.jit(linker.link_context)
+
+    # -- the union lexsort alone ----------------------------------------
+    def just_sort(x):
+        n = x.valid.shape[0]
+        has_parent = ((x.p0 | x.p1) != 0) & x.valid
+        anyvalid = jnp.concatenate([x.valid, has_parent])
+
+        def lane(t, q):
+            return jnp.where(
+                anyvalid,
+                jnp.concatenate([t.astype(jnp.uint32), q.astype(jnp.uint32)]),
+                jnp.uint32(0xFFFFFFFF),
+            )
+
+        id_lanes = [
+            lane(x.trace_h, x.trace_h),
+            lane(x.s0, x.p0),
+            lane(x.s1, x.p1),
+        ]
+        svc_lane = lane(x.svc.astype(jnp.uint32), x.svc.astype(jnp.uint32))
+        return jnp.lexsort((svc_lane,) + tuple(id_lanes))
+
+    pieces["lexsort_4key_2R"] = jax.jit(just_sort)
+
+    # -- fixed-schedule doubling baseline (the r3 implementation,
+    # inlined: linker.chase_ancestors is now convergence-bounded, so
+    # calling it here would measure the NEW code twice, not the old
+    # 19-pass schedule this baseline documents) -------------------------
+    def fixed_doubling(parent, kind):
+        n = parent.shape[0]
+        sent = n
+        par = jnp.where(parent >= 0, parent, sent)
+        kind_ext = jnp.concatenate([kind, jnp.zeros((1,), kind.dtype)])
+        par_ext = jnp.concatenate([par, jnp.full((1,), sent, par.dtype)])
+        jump = jnp.where(kind_ext != 0, jnp.arange(n + 1), par_ext)
+        jump = jump.at[sent].set(sent)
+        ptr = par_ext
+        for _ in range(max(int(n).bit_length(), 1)):
+            jump = jump[jump]
+            ptr = ptr[ptr]
+        anc = jump[par]
+        anc = jnp.where(anc == sent, -1, anc)
+        anc = jnp.where(
+            (anc >= 0) & (kind_ext[jnp.where(anc >= 0, anc, 0)] != 0), anc, -1
+        )
+        return anc, ptr[:n] == sent
+
+    # -- fixed-point doubling: stop when converged ----------------------
+    def converged_doubling(parent, kind):
+        n = parent.shape[0]
+        sent = n
+        par = jnp.where(parent >= 0, parent, sent)
+        kind_ext = jnp.concatenate([kind, jnp.zeros((1,), kind.dtype)])
+        par_ext = jnp.concatenate([par, jnp.full((1,), sent, par.dtype)])
+        jump = jnp.where(kind_ext != 0, jnp.arange(n + 1), par_ext)
+        jump = jump.at[sent].set(sent)
+        root = jnp.concatenate([par, jnp.full((1,), sent, par.dtype)])
+
+        def cond(c):
+            jump, root, changed = c
+            return changed
+
+        def body(c):
+            jump, root, _ = c
+            j2 = jump[jump]
+            r2 = root[root]
+            changed = jnp.any(j2 != jump) | jnp.any(r2 != root)
+            return j2, r2, changed
+
+        jump, root, _ = jax.lax.while_loop(
+            cond, body, (jump, root, jnp.bool_(True))
+        )
+        anc = jump[par]
+        anc = jnp.where(anc == sent, -1, anc)
+        anc = jnp.where(
+            (anc >= 0) & (kind_ext[jnp.where(anc >= 0, anc, 0)] != 0), anc, -1
+        )
+        return anc, root[:n] == sent
+
+    # parent arrays for the chases come from the real resolve step
+    parent_host, _ = jax.jit(linker.resolve_parents)(x)
+    parent_host = jax.device_put(parent_host)
+    kindv = jnp.where(x.valid, x.kind, 0)
+
+    results = {}
+
+    def timeit(name, fn, *args, reps=5):
+        out = fn(*args)  # compile
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+        xs = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+            xs.append((time.perf_counter() - t0) * 1e3)
+        results[name] = round(sorted(xs)[len(xs) // 2], 2)
+
+    timeit("full_link_ctx", full, x)
+    timeit("lexsort_4key_2R", pieces["lexsort_4key_2R"], x)
+    timeit("fixed_doubling", jax.jit(fixed_doubling), parent_host, kindv)
+    timeit("converged_doubling", jax.jit(converged_doubling), parent_host, kindv)
+
+    # XPlane capture for device-time attribution of the same calls
+    device = {}
+    try:
+        from benchmarks.xplane_tools import device_op_totals, latest_xspace
+
+        trace_dir = tempfile.mkdtemp(prefix="linkctx_prof_")
+        with jax.profiler.trace(trace_dir):
+            full(x)
+            pieces["lexsort_4key_2R"](x)
+            jax.jit(fixed_doubling)(parent_host, kindv)
+            jax.jit(converged_doubling)(parent_host, kindv)
+            jax.block_until_ready(x)
+        space = latest_xspace(trace_dir)
+        for op, (us, cnt) in sorted(
+            device_op_totals(space).items(), key=lambda kv: -kv[1][0]
+        )[:16]:
+            device[op] = {"total_ms": round(us / 1e3, 3), "count": cnt}
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    except Exception as e:  # pragma: no cover
+        device = {"error": str(e)}
+
+    print(json.dumps({
+        "artifact": "profile_link_ctx",
+        "ring_capacity": r,
+        "wall_ms_p50": results,
+        "device_ops_ms": device,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

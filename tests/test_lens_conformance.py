@@ -1,0 +1,304 @@
+"""Lens-client conformance fixtures (VERDICT r3 order 8).
+
+The Lens UI is a sanctioned descope (network disabled — the bundle
+cannot be fetched; SURVEY.md §2.5 sets API-shape compatibility as the
+bar), but nothing pinned the EXACT query-parameter shapes a real Lens
+sends. These are golden request/response tests using the literal URL
+shapes zipkin-lens produces (URL-encoded exactly as its fetch layer
+does), asserted against the server with BOTH storages — a future real
+Lens can be pointed at this server with confidence.
+
+Request shapes mirrored from zipkin-lens's api constants
+(``zipkin-lens/src/constants/api.ts``) and its discover-page query
+builder: ``serviceName``, ``spanName``, ``remoteServiceName``,
+``annotationQuery`` (``k1=v1 and k2`` grammar), ``minDuration``/
+``maxDuration``, ``endTs``/``lookback`` (epoch ms), ``limit``,
+``autocompleteKeys``/``autocompleteValues?key=``, and the
+``strictTraceId`` server mode for 64-vs-128-bit trace-id lookups.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.parse
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.fixtures import BACKEND, FRONTEND, TODAY, TRACE, TRACE_ID
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.model.span import Endpoint, Kind, Span
+from zipkin_tpu.server.app import ZipkinServer
+from zipkin_tpu.server.config import ServerConfig
+from zipkin_tpu.tpu.state import AggConfig
+
+DAY_MS = 86_400_000
+TODAY_US = TODAY * 1000
+QUERY_TS = TODAY + 3_600_000  # endTs Lens sends: "now", epoch ms
+
+SMALL = AggConfig(
+    max_services=64, max_keys=256, hll_precision=9,
+    digest_centroids=32, ring_capacity=1 << 13,
+)
+
+# a second trace carrying the tag/autocomplete surface Lens filters on
+TAGGED_TRACE_ID = "00000000000000020000000000000bee"
+TAGGED = [
+    Span.create(
+        trace_id=TAGGED_TRACE_ID,
+        id="000000000000000a",
+        name="options /",
+        kind=Kind.SERVER,
+        local_endpoint=FRONTEND,
+        timestamp=TODAY_US + 1_000_000,
+        duration=42_000,
+        tags={"env": "prod", "http.method": "OPTIONS"},
+    ),
+    Span.create(
+        trace_id=TAGGED_TRACE_ID,
+        id="000000000000000b",
+        parent_id="000000000000000a",
+        name="get /api",
+        kind=Kind.CLIENT,
+        local_endpoint=FRONTEND,
+        remote_endpoint=BACKEND,
+        timestamp=TODAY_US + 1_010_000,
+        duration=30_000,
+        tags={"env": "staging"},
+        annotations=[(TODAY_US + 1_011_000, "retry")],
+    ),
+]
+
+
+def make_server(storage_type: str) -> ZipkinServer:
+    cfg = ServerConfig(
+        default_lookback=DAY_MS, autocomplete_keys=("env",),
+        storage_type=storage_type,
+    )
+    if storage_type == "tpu":
+        from zipkin_tpu.storage.tpu import TpuStorage
+
+        storage = TpuStorage(
+            config=SMALL, num_devices=8, autocomplete_keys=("env",)
+        )
+        return ZipkinServer(cfg, storage=storage)
+    return ZipkinServer(cfg)
+
+
+def run(storage_type, scenario):
+    async def wrapper():
+        server = make_server(storage_type)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/api/v2/spans",
+                data=json_v2.encode_span_list(TRACE + TAGGED),
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 202
+            await scenario(client)
+        finally:
+            await client.close()
+
+    asyncio.run(wrapper())
+
+
+STORAGES = ("mem", "tpu")
+
+
+async def get_json(client, path_qs: str):
+    resp = await client.get(path_qs)
+    assert resp.status == 200, await resp.text()
+    return json.loads(await resp.text())
+
+
+def trace_ids(traces_json) -> set:
+    return {t[0]["traceId"] for t in traces_json}
+
+
+@pytest.mark.parametrize("storage_type", STORAGES)
+class TestLensDiscoverShapes:
+    """The exact /api/v2/traces?... URLs the Lens discover page emits."""
+
+    def test_service_and_span_name(self, storage_type):
+        async def scenario(client):
+            # Lens encodes spaces as %20 in spanName
+            url = (
+                f"/api/v2/traces?serviceName=frontend&spanName=get%20%2F"
+                f"&endTs={QUERY_TS}&lookback={DAY_MS}&limit=10"
+            )
+            out = await get_json(client, url)
+            assert trace_ids(out) == {TRACE_ID}
+
+        run(storage_type, scenario)
+
+    def test_annotation_query_tag_equals_and_bare_key(self, storage_type):
+        async def scenario(client):
+            # grammar: "http.method=OPTIONS and env=prod" — ' and ' joined,
+            # URL-encoded by Lens's fetch layer
+            q = urllib.parse.quote("http.method=OPTIONS and env=prod")
+            url = (
+                f"/api/v2/traces?serviceName=frontend&annotationQuery={q}"
+                f"&endTs={QUERY_TS}&lookback={DAY_MS}&limit=10"
+            )
+            out = await get_json(client, url)
+            assert trace_ids(out) == {TAGGED_TRACE_ID}
+            # bare key form: an ANNOTATION value ("retry")
+            q = urllib.parse.quote("retry")
+            url = (
+                f"/api/v2/traces?serviceName=frontend&annotationQuery={q}"
+                f"&endTs={QUERY_TS}&lookback={DAY_MS}&limit=10"
+            )
+            out = await get_json(client, url)
+            assert trace_ids(out) == {TAGGED_TRACE_ID}
+            # no-match compound: every clause must hold
+            q = urllib.parse.quote("env=prod and http.method=GET")
+            url = (
+                f"/api/v2/traces?serviceName=frontend&annotationQuery={q}"
+                f"&endTs={QUERY_TS}&lookback={DAY_MS}&limit=10"
+            )
+            out = await get_json(client, url)
+            assert out == []
+
+        run(storage_type, scenario)
+
+    def test_min_max_duration_microseconds(self, storage_type):
+        async def scenario(client):
+            # Lens sends durations in MICROSECONDS
+            url = (
+                f"/api/v2/traces?serviceName=frontend&minDuration=300000"
+                f"&endTs={QUERY_TS}&lookback={DAY_MS}&limit=10"
+            )
+            out = await get_json(client, url)
+            assert trace_ids(out) == {TRACE_ID}  # 350ms root span
+            url = (
+                f"/api/v2/traces?serviceName=frontend&minDuration=10000"
+                f"&maxDuration=50000&endTs={QUERY_TS}&lookback={DAY_MS}"
+                f"&limit=10"
+            )
+            out = await get_json(client, url)
+            assert trace_ids(out) == {TAGGED_TRACE_ID}  # 42ms + 30ms spans
+
+        run(storage_type, scenario)
+
+    def test_remote_service_name(self, storage_type):
+        async def scenario(client):
+            url = (
+                f"/api/v2/traces?serviceName=backend&remoteServiceName=mysql"
+                f"&endTs={QUERY_TS}&lookback={DAY_MS}&limit=10"
+            )
+            out = await get_json(client, url)
+            assert trace_ids(out) == {TRACE_ID}
+
+        run(storage_type, scenario)
+
+    def test_limit_and_ordering_newest_first(self, storage_type):
+        async def scenario(client):
+            url = (
+                f"/api/v2/traces?endTs={QUERY_TS}&lookback={DAY_MS}&limit=1"
+            )
+            out = await get_json(client, url)
+            assert len(out) == 1
+            # upstream returns traces ordered by timestamp descending:
+            # the TAGGED trace is newer
+            assert trace_ids(out) == {TAGGED_TRACE_ID}
+
+        run(storage_type, scenario)
+
+
+@pytest.mark.parametrize("storage_type", STORAGES)
+class TestLensLookupAndAutocomplete:
+    def test_service_span_remote_lists(self, storage_type):
+        async def scenario(client):
+            # mysql is only ever a REMOTE endpoint: local service names
+            # exclude it (upstream ServiceAndSpanNames semantics)
+            assert await get_json(client, "/api/v2/services") == [
+                "backend", "frontend",
+            ]
+            assert await get_json(
+                client, "/api/v2/spans?serviceName=frontend"
+            ) == ["get /", "get /api", "options /"]
+            assert await get_json(
+                client, "/api/v2/remoteServices?serviceName=backend"
+            ) == ["mysql"]
+
+        run(storage_type, scenario)
+
+    def test_autocomplete_endpoints(self, storage_type):
+        async def scenario(client):
+            assert await get_json(client, "/api/v2/autocompleteKeys") == [
+                "env"
+            ]
+            assert await get_json(
+                client, "/api/v2/autocompleteValues?key=env"
+            ) == ["prod", "staging"]
+            # unknown key: empty list, not an error (upstream shape)
+            assert await get_json(
+                client, "/api/v2/autocompleteValues?key=nope"
+            ) == []
+
+        run(storage_type, scenario)
+
+    def test_dependencies_shape(self, storage_type):
+        async def scenario(client):
+            out = await get_json(
+                client,
+                f"/api/v2/dependencies?endTs={QUERY_TS}&lookback={DAY_MS}",
+            )
+            by_pair = {(d["parent"], d["child"]): d for d in out}
+            assert ("frontend", "backend") in by_pair
+            assert ("backend", "mysql") in by_pair
+            assert by_pair[("backend", "mysql")]["callCount"] == 1
+            # errorCount present only when nonzero (upstream omits zeros)
+            assert by_pair[("backend", "mysql")].get("errorCount") == 1
+            assert "errorCount" not in by_pair[("frontend", "backend")]
+
+        run(storage_type, scenario)
+
+
+class TestStrictTraceId:
+    """Lens depends on the server's strictTraceId mode for short-id
+    lookups: 128-bit ids must be fetchable by their 64-bit suffix when
+    STRICT_TRACE_ID=false (the upstream migration mode)."""
+
+    def _server(self, strict: bool) -> ZipkinServer:
+        return ZipkinServer(ServerConfig(
+            default_lookback=DAY_MS, strict_trace_id=strict,
+        ))
+
+    def _run(self, strict, scenario):
+        async def wrapper():
+            server = self._server(strict)
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 202
+                await scenario(client)
+            finally:
+                await client.close()
+
+        asyncio.run(wrapper())
+
+    def test_lenient_matches_64bit_suffix(self):
+        async def scenario(client):
+            resp = await client.get("/api/v2/trace/0000000000000ace")
+            assert resp.status == 200
+            spans = json.loads(await resp.text())
+            assert {s["traceId"] for s in spans} == {TRACE_ID}
+
+        self._run(False, scenario)
+
+    def test_strict_requires_full_id(self):
+        async def scenario(client):
+            resp = await client.get("/api/v2/trace/0000000000000ace")
+            assert resp.status == 404
+            resp = await client.get(f"/api/v2/trace/{TRACE_ID}")
+            assert resp.status == 200
+
+        self._run(True, scenario)

@@ -125,10 +125,11 @@ def _load():
         for fn in (lib.zt_intern_service, lib.zt_intern_name):
             fn.restype = ctypes.c_long
             fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
-        lib.zt_intern_pair.restype = ctypes.c_long
-        lib.zt_intern_pair.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
-        ]
+        for fn in (lib.zt_intern_pair, lib.zt_intern_pair_raw):
+            fn.restype = ctypes.c_long
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ]
         _lib = lib
         return _lib
 
@@ -243,7 +244,11 @@ class NativeVocab:
             assert got == nid, (got, nid, raw)
         for kid in range(c_pair + 1, len(v._key_list)):
             s, n = v._key_list[kid]
-            got = lib.zt_intern_pair(self.handle, s, n)
+            # _raw: position-faithful replay — the Python list records
+            # the exact id order (including or excluding catch-all rows,
+            # per the build that wrote it); the live interning rules
+            # must not re-derive insertions here or ids shift
+            got = lib.zt_intern_pair_raw(self.handle, s, n)
             assert got == kid, (got, kid, (s, n))
         # drain journals so the replay isn't re-reported as new
         self.sync()

@@ -516,7 +516,25 @@ static int pairtab_init(pairtab_t *t, uint32_t max_ids) {
   return (t->key && t->id && t->journal) ? 0 : -1;
 }
 
-static uint32_t pairtab_intern(pairtab_t *t, uint32_t a, uint32_t b) {
+static uint32_t pairtab_find(const pairtab_t *t, uint32_t a, uint32_t b) {
+  uint64_t k = ((uint64_t)a << 32) | b | 0x8000000000000000ull;
+  size_t mask = t->slots - 1;
+  uint64_t h = k * 0x9E3779B97F4A7C15ull;
+  size_t slot = (size_t)(h >> 32) & mask;
+  for (;;) {
+    if (t->id[slot] == 0) return 0;
+    if (t->key[slot] == k) return t->id[slot];
+    slot = (slot + 1) & mask;
+  }
+}
+
+/* raw probe+insert: NO derived insertions, so replay paths can
+   reproduce a historical id assignment verbatim whatever interning
+   rules the writing build used (position-faithful). count_overflow=0
+   for the derived catch-all pre-reserve, so one rejected intern counts
+   exactly once — matching the Python interner's accounting. */
+static uint32_t pairtab_put(pairtab_t *t, uint32_t a, uint32_t b,
+                            int count_overflow) {
   uint64_t k = ((uint64_t)a << 32) | b | 0x8000000000000000ull; /* nonzero */
   size_t mask = t->slots - 1;
   uint64_t h = k * 0x9E3779B97F4A7C15ull;
@@ -526,11 +544,31 @@ static uint32_t pairtab_intern(pairtab_t *t, uint32_t a, uint32_t b) {
     if (t->key[slot] == k) return t->id[slot];
     slot = (slot + 1) & mask;
   }
-  if (t->next_id > t->max_ids) { t->overflow++; return 0; }
+  if (t->next_id > t->max_ids) {
+    if (count_overflow) t->overflow++;
+    return 0;
+  }
   t->key[slot] = k;
   t->id[slot] = t->next_id;
   t->journal[t->journal_count++] = ((uint64_t)a << 32) | b;
   return t->next_id++;
+}
+
+static uint32_t pairtab_intern(pairtab_t *t, uint32_t a, uint32_t b) {
+  uint32_t got = pairtab_find(t, a, b);
+  if (got) return got;
+  /* pre-reserve the per-service catch-all (a, 0) BEFORE the named
+     pair — the Python interner does the same, in the same order, so
+     the two id streams stay identical. Past capacity, span-name churn
+     then aggregates under its SERVICE's catch-all row instead of the
+     global unknown row 0 (VERDICT r3 order 5). service 0 is the
+     global unknown itself: no catch-all (a shadow (0,0) row would
+     hijack unknown-service mass from row 0). */
+  if (b != 0 && a != 0) pairtab_put(t, a, 0, 0);
+  got = pairtab_put(t, a, b, 1);
+  if (got) return got;
+  if (b != 0 && a != 0) return pairtab_find(t, a, 0);
+  return 0;
 }
 
 void *zt_vocab_new(uint32_t max_services, uint32_t max_names,
@@ -642,4 +680,11 @@ long zt_intern_name(void *vp, const uint8_t *s, uint32_t len) {
 }
 long zt_intern_pair(void *vp, uint32_t svc, uint32_t name) {
   return (long)pairtab_intern(&((vocab_t *)vp)->pairs, svc, name);
+}
+/* position-faithful insert for replay (ensure_synced): records the pair
+   at the next id with NO catch-all derivation, so a vocabulary written
+   by any build — including pre-catch-all layouts — replays to identical
+   ids. */
+long zt_intern_pair_raw(void *vp, uint32_t svc, uint32_t name) {
+  return (long)pairtab_put(&((vocab_t *)vp)->pairs, svc, name, 1);
 }

@@ -15,14 +15,17 @@ Algorithm over a columnar span window (all arrays fixed-shape ``[n]``):
    spans (its client half); a normal span resolves its ``parentId``
    preferring the shared rendition (the server half is the closer tree
    node, matching ``zipkin2/internal/SpanNode.java``'s index preference),
-   falling back to non-shared. Each join is one lexsort of the union +
-   a per-run max — no data-dependent control flow.
+   falling back to non-shared. All joins ride ONE value-carrying
+   ``lax.sort`` of the union; per-run first-wins candidates are
+   segmented min scans over the contiguous sorted runs — no
+   data-dependent control flow, no gather passes.
 2. **has-child** marks (scatter-max) implement rule 1 of the linker
    (a CLIENT span with children defers to its server half).
 3. **Nearest RPC ancestor** by pointer doubling: ``jump[i]`` points to the
-   nearest ancestor-or-self with a kind; squaring it ceil(log2 n) times
-   resolves chains of any depth in O(log n) passes — the device analog
-   of ``_find_rpc_ancestor``'s while-loop.
+   nearest ancestor-or-self with a kind; squaring it until the fixed
+   point (convergence-bounded ``lax.while_loop``, pass count capped at
+   ceil(log2 n) so malformed cycles terminate) resolves chains of any
+   depth — the device analog of ``_find_rpc_ancestor``'s while-loop.
 4. **Rule application** is a pure vectorized select emitting up to two
    edges per span (main + rule-6b backfill), then a scatter-add into the
    ``[services, services]`` call/error matrices — which merge across
@@ -95,39 +98,11 @@ def _run_min(values: jnp.ndarray, change: jnp.ndarray, none: int) -> jnp.ndarray
     return jnp.where(out >= none, -1, out)
 
 
-def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Tree edges from id joins: returns (parent_row [n] with -1 for roots,
-    has_child [n] bool).
-
-    All three id joins (shared half -> client half, parent-id -> shared
-    rendition, parent-id -> non-shared) ride ONE lexsort of a 2n-lane
-    union — table lanes keyed by own (trace, span-id), query lanes keyed
-    by (trace, parent-id) — with per-run maxima taken separately over
-    shared and non-shared table indices. The r2 profile capture showed the
-    original three independent sort-merge joins dominating the rollup
-    program (PROFILE_r02.md); one sort does the work of three.
-    """
-    n = x.valid.shape[0]
+def union_key_lanes(x: LinkInput):
+    """The four u32 sort-key lanes of the 2n-lane join union (table half
+    then query half), invalid lanes keyed 0xFFFFFFFF."""
     has_parent = ((x.p0 | x.p1) != 0) & x.valid
-    nonshared = x.valid & ~x.shared
-    sharedv = x.valid & x.shared
-
-    # Join identity: (trace_h, id). trace_h is a 32-bit avalanche hash of
-    # the FULL 128-bit trace id — dropping the exact low-64 lanes from
-    # the sort key cuts the lexsort from 6 to 4 passes, and a false join
-    # needs a 32-bit trace-hash collision AND a 64-bit span-id match
-    # within one ring (~2^-40 per colliding pair; the reference tolerates
-    # far larger sketch error elsewhere).
-    own_key = (x.trace_h, x.s0, x.s1)
-    parent_key = (x.trace_h, x.p0, x.p1)
-    # ALL spans with parents query the parent-id join — including shared
-    # halves: a shared server span prefers its same-id client half, but
-    # when that mate is absent it must fall back to its parentId exactly
-    # like SpanNode.Builder does (found by the linker fuzz: a mateless
-    # shared span previously became a root and re-attributed its edge)
-    q_valid = has_parent
-
-    anyvalid = jnp.concatenate([x.valid, q_valid])
+    anyvalid = jnp.concatenate([x.valid, has_parent])
 
     def lane(t, q):
         return jnp.where(
@@ -136,7 +111,17 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
             jnp.uint32(0xFFFFFFFF),
         )
 
-    id_lanes = [lane(t, q) for t, q in zip(own_key, parent_key)]
+    # Join identity: (trace_h, id). trace_h is a 32-bit avalanche hash of
+    # the FULL 128-bit trace id — dropping the exact low-64 lanes from
+    # the sort key cuts the lexsort from 6 to 4 passes, and a false join
+    # needs a 32-bit trace-hash collision AND a 64-bit span-id match
+    # within one ring (~2^-40 per colliding pair; the reference tolerates
+    # far larger sketch error elsewhere).
+    id_lanes = [
+        lane(x.trace_h, x.trace_h),
+        lane(x.s0, x.p0),
+        lane(x.s1, x.p1),
+    ]
     # service lane: table lanes carry their OWN service, query lanes the
     # CHILD's — so a run of the (id, svc) composite matches candidates
     # whose service equals the child's, the endpoint-aware preference of
@@ -144,6 +129,76 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
     # plain (id) runs stay contiguous and both granularities come from
     # ONE sort.
     svc_lane = lane(x.svc.astype(jnp.uint32), x.svc.astype(jnp.uint32))
+    return id_lanes, svc_lane, has_parent
+
+
+def _seg_min_scan(vals, flags, reverse=False):
+    """Segmented inclusive min scan over contiguous runs (reset where
+    ``flags``). The scans replace the scatter-min/gather formulation:
+    at ring capacity 2^18 the scatter variant measured 59.3 ms for the
+    whole resolve vs 23.6 ms with scans (benchmarks r4 A/B on chip)."""
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, jnp.minimum(va, vb))
+
+    if reverse:
+        vals = jnp.flip(vals)
+        flags = jnp.flip(flags)
+    _, v = jax.lax.associative_scan(combine, (flags, vals))
+    return jnp.flip(v) if reverse else v
+
+
+def _run_min_bcast(vals, starts, none):
+    """Per-run min broadcast to every lane of the run (sorted contiguous
+    runs): forward segmented prefix-min covers [start..lane], backward
+    covers [lane..end]; their minimum is the full-run min. ``none`` is
+    the empty sentinel; absent runs return -1. Values are insertion-
+    sequence ranks (see LinkInput.seq), so min = FIRST in insertion
+    order, matching the host tree builder\'s first-wins candidate choice
+    even after a circular ring wraps."""
+    ends = jnp.concatenate([starts[1:], jnp.ones((1,), bool)])
+    fwd = _seg_min_scan(vals, starts)
+    bwd = _seg_min_scan(vals, ends, reverse=True)
+    out = jnp.minimum(fwd, bwd)
+    return jnp.where(out >= none, -1, out)
+
+
+def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Tree edges from id joins: returns (parent_row [n] with -1 for roots,
+    has_child [n] bool).
+
+    All three id joins (shared half -> client half, parent-id -> shared
+    rendition, parent-id -> non-shared) ride ONE multi-operand
+    ``lax.sort`` of a 2n-lane union — table lanes keyed by own
+    (trace, span-id), query lanes keyed by (trace, parent-id) — that
+    CARRIES the candidate values and selection flags through the sort.
+    Everything after the sort is contiguous: run boundaries are
+    adjacent-lane compares, per-run first-wins candidates are segmented
+    min scans, and the SpanNode._choose_parent preference chain is
+    evaluated in sorted space so only ONE combined candidate needs
+    un-permuting.
+
+    That shape is the r4 redesign of the fresh dependency read
+    (VERDICT r3 order 1): the r3 formulation un-permuted three
+    candidate arrays through gather/scatter passes and fixed-schedule
+    pointer chases, costing 145.8 ms captured device time at ring
+    capacity 2^18; this one measures 23.6 ms for the resolve and
+    34.3 ms for the full link context (chip A/B, bit-identical output).
+    """
+    n = x.valid.shape[0]
+    has_parent = ((x.p0 | x.p1) != 0) & x.valid
+    nonshared = x.valid & ~x.shared
+    sharedv = x.valid & x.shared
+    # ALL spans with parents query the parent-id join — including shared
+    # halves: a shared server span prefers its same-id client half, but
+    # when that mate is absent it must fall back to its parentId exactly
+    # like SpanNode.Builder does (found by the linker fuzz: a mateless
+    # shared span previously became a root and re-attributed its edge)
+    q_valid = has_parent
+
+    id_lanes, svc_lane, _ = union_key_lanes(x)
 
     idx = jnp.arange(n, dtype=jnp.int32)
     # candidate VALUES are insertion-sequence ranks, not lane indices —
@@ -155,50 +210,56 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
     far = jnp.full((n,), sent, jnp.int32)
     val_sh = jnp.concatenate([jnp.where(sharedv, seq, sent), far])
     val_ns = jnp.concatenate([jnp.where(nonshared, seq, sent), far])
+    # query half carries the span\'s shared flag so the sorted-space
+    # selection can pick fallback-vs-preference without a second unsort
+    qsh = jnp.concatenate([jnp.zeros((n,), bool), sharedv])
+    uidx = jnp.arange(2 * n, dtype=jnp.int32)
 
-    order = jnp.lexsort((svc_lane,) + tuple(id_lanes))
-    coarse = _run_starts([l[order] for l in id_lanes])
-    fine = coarse | jnp.asarray(segment_starts(svc_lane[order]))
-    sh_sorted = val_sh[order]
-    ns_sorted = val_ns[order]
-    results = [
-        _run_min(sh_sorted, fine, sent),   # shared, same service
-        _run_min(sh_sorted, coarse, sent),  # any shared
-        _run_min(ns_sorted, coarse, sent),  # first non-shared
-    ]
-    inv = jnp.zeros(2 * n, jnp.int32)
-    to_idx = lambda r: jnp.where(
-        r >= 0, rank_to_idx[jnp.where(r >= 0, r, 0)], -1
+    sorted_ops = jax.lax.sort(
+        tuple(id_lanes) + (svc_lane, val_sh, val_ns, qsh, uidx), num_keys=4
     )
-    un = [to_idx(inv.at[order].set(r)) for r in results]
-    sh_fine, sh_any, ns_any = un
+    s_ids = sorted_ops[:3]
+    s_svc, sh_s, ns_s, s_qsh, sord = sorted_ops[3:]
 
-    # Parent-id resolution in SpanNode._choose_parent preference order:
-    # 1) first shared with the child's service, 2) the FIRST non-shared
-    # (primary_by_id — the host never service-scans non-shared
-    # candidates, it checks whether THE first one's service matches),
-    # 3) first shared any service, 4) the first non-shared regardless.
-    primary = ns_any[n:]
-    primary_svc = x.svc[jnp.where(primary >= 0, primary, 0)]
-    child_svc = x.svc
-    primary_matches = (primary >= 0) & (primary_svc == child_svc)
+    coarse = _run_starts(list(s_ids))
+    fine = coarse | jnp.asarray(segment_starts(s_svc))
+
+    r_sh_fine = _run_min_bcast(sh_s, fine, sent)   # shared, same service
+    r_sh_any = _run_min_bcast(sh_s, coarse, sent)  # any shared
+    r_ns_any = _run_min_bcast(ns_s, coarse, sent)  # first non-shared
+
+    # Parent-id resolution in SpanNode._choose_parent preference order,
+    # evaluated PER SORTED LANE: 1) first shared with the child\'s
+    # service, 2) the FIRST non-shared (primary_by_id — the host never
+    # service-scans non-shared candidates, it checks whether THE first
+    # one\'s service matches), 3) first shared any service, 4) the first
+    # non-shared regardless. s_svc carries the child\'s service on query
+    # lanes (garbage on table lanes — never selected there).
+    primary = r_ns_any
+    p_idx = rank_to_idx[jnp.where(primary >= 0, primary, 0)]
+    primary_svc = x.svc[p_idx].astype(jnp.uint32)
+    primary_matches = (primary >= 0) & (primary_svc == s_svc)
     by_parent_id = primary
-    by_parent_id = jnp.where(sh_any[n:] >= 0, sh_any[n:], by_parent_id)
+    by_parent_id = jnp.where(r_sh_any >= 0, r_sh_any, by_parent_id)
     by_parent_id = jnp.where(primary_matches, primary, by_parent_id)
-    by_parent_id = jnp.where(sh_fine[n:] >= 0, sh_fine[n:], by_parent_id)
-    by_parent_id = jnp.where(q_valid, by_parent_id, -1)
+    by_parent_id = jnp.where(r_sh_fine >= 0, r_sh_fine, by_parent_id)
 
-    # shared half -> first client half with MY id (any service), else the
-    # first NON-shared span with my parent id (the host builder's shared
-    # fallback consults only primary_by_id — no endpoint preference, no
-    # shared candidates); normal span -> full parent-id preference chain
-    j_shared = jnp.where(sharedv, ns_any[:n], -1)
-    shared_fallback = jnp.where(q_valid, ns_any[n:], -1)
-    parent = jnp.where(
-        sharedv,
-        jnp.where(j_shared >= 0, j_shared, shared_fallback),
-        by_parent_id,
-    )
+    # per-lane combined candidate: table lanes only ever need the first
+    # non-shared of their OWN-id run (the shared->client join); query
+    # lanes of SHARED spans need the same of their PARENT-id run (the
+    # host builder\'s shared fallback consults only primary_by_id — no
+    # endpoint preference); query lanes of normal spans take the full
+    # preference chain
+    is_table = sord < n
+    combined = jnp.where(is_table | s_qsh, r_ns_any, by_parent_id)
+
+    # ONE unsort: scatter the combined rank, convert rank -> lane index
+    inv = jnp.zeros(2 * n, jnp.int32).at[sord].set(combined)
+    un = jnp.where(inv >= 0, rank_to_idx[jnp.where(inv >= 0, inv, 0)], -1)
+
+    j_shared = jnp.where(sharedv, un[:n], -1)
+    q = jnp.where(q_valid, un[n:], -1)
+    parent = jnp.where(sharedv, jnp.where(j_shared >= 0, j_shared, q), q)
     # a span must not become its own parent (self-parent -> dangling root,
     # as the host builder treats a self-referential choice)
     parent = jnp.where(parent == idx, -1, parent)
@@ -212,29 +273,28 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return parent, has_child.astype(bool)
 
 
-def reaches_root(parent: jnp.ndarray) -> jnp.ndarray:
-    """[n] bool: the parent chain terminates at a root (within depth
-    any depth). Malformed cyclic subgraphs (e.g. a span pair parenting
-    each other through a shared-id join) never terminate — the host tree
-    builder leaves them unreachable from the synthetic root, so its
-    traversal never emits their links; this mask is the device analog
-    (found by the linker fuzz)."""
-    n = parent.shape[0]
-    sent = n
-    ptr = jnp.concatenate(
-        [jnp.where(parent >= 0, parent, sent), jnp.full((1,), sent, parent.dtype)]
-    )
-    for _ in range(_doubling_passes(n)):
-        ptr = ptr[ptr]
-    return ptr[:n] == sent
-
-
-def nearest_rpc_ancestor(
+def chase_ancestors(
     parent: jnp.ndarray, kind: jnp.ndarray
-) -> jnp.ndarray:
-    """Row index of the nearest strict ancestor with a kind, else -1.
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Both pointer-doubling chases of the link rules in ONE
+    convergence-bounded loop: returns (anc [n] — nearest strict ancestor
+    with a kind, else -1; root_ok [n] bool — the parent chain terminates
+    at a root).
 
-    Pointer doubling with a sentinel row ``n`` standing in for "none".
+    Doubling squares two pointer arrays per pass: ``root`` chases
+    ``parent`` toward the sentinel, ``jump`` chases the
+    nearest-kinded-ancestor-or-self relation. A fixed
+    ceil(log2(n)) schedule costs 19 passes at ring capacity 2^18 —
+    70.6 ms captured device time, HALF the 145.8 ms fresh link-context
+    rebuild (benchmarks/profile_link_ctx.py) — yet real trace forests
+    are tens deep, converged after 5-8 passes. The lax.while_loop stops
+    at the fixed point (captured: 10.7 ms, 6.6x) and stays EXACT for
+    any depth: the fixed pass count remains as a bound only so
+    malformed parent CYCLES (which never reach a fixed point — a
+    3-cycle's pointers orbit forever) still terminate; capped cyclic
+    lanes end mid-cycle, never at the sentinel, so ``root_ok`` stays
+    False for them exactly as the host tree builder's reachability
+    does (found by the linker fuzz).
     """
     n = parent.shape[0]
     sent = n
@@ -245,13 +305,52 @@ def nearest_rpc_ancestor(
     # jump[i] = i if span i has a kind, else its parent (toward the root)
     jump = jnp.where(kind_ext != 0, jnp.arange(n + 1), par_ext)
     jump = jump.at[sent].set(sent)
-    for _ in range(_doubling_passes(n)):
-        jump = jump[jump]
+    root = par_ext
+    max_passes = _doubling_passes(n)
+
+    def cond(c):
+        i, _, _, changed = c
+        return changed & (i < max_passes)
+
+    def body(c):
+        i, jump, root, _ = c
+        j2 = jump[jump]
+        r2 = root[root]
+        changed = jnp.any(j2 != jump) | jnp.any(r2 != root)
+        return i + 1, j2, r2, changed
+
+    # initial `changed` derives from the (possibly shard-varying) data so
+    # the while carry types stay consistent under shard_map; jump holds
+    # only non-negative lane ids, so this is always True
+    _, jump, root, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jump, root, jnp.any(jump >= 0))
+    )
 
     anc = jump[par]  # start the walk at the parent (strict ancestor)
     anc = jnp.where(anc == sent, -1, anc)
     # if the chain ended on a kindless root, there is no RPC ancestor
-    anc = jnp.where((anc >= 0) & (kind_ext[jnp.where(anc >= 0, anc, 0)] != 0), anc, -1)
+    anc = jnp.where(
+        (anc >= 0) & (kind_ext[jnp.where(anc >= 0, anc, 0)] != 0), anc, -1
+    )
+    return anc, root[:n] == sent
+
+
+def reaches_root(parent: jnp.ndarray) -> jnp.ndarray:
+    """[n] bool: the parent chain terminates at a root (any depth).
+    Malformed cyclic subgraphs (e.g. a span pair parenting each other
+    through a shared-id join) never terminate — the host tree builder
+    leaves them unreachable from the synthetic root, so its traversal
+    never emits their links; this mask is the device analog (found by
+    the linker fuzz)."""
+    _, ok = chase_ancestors(parent, jnp.zeros_like(parent))
+    return ok
+
+
+def nearest_rpc_ancestor(
+    parent: jnp.ndarray, kind: jnp.ndarray
+) -> jnp.ndarray:
+    """Row index of the nearest strict ancestor with a kind, else -1."""
+    anc, _ = chase_ancestors(parent, kind)
     return anc
 
 
@@ -281,9 +380,10 @@ def link_context(x: LinkInput) -> LinkContext:
     the reference's whole-trace linking (InMemory getDependencies links
     full traces whose span timestamps intersect the window, SURVEY.md
     §3.5).
+
     """
     parent, has_child = resolve_parents(x)
-    anc = nearest_rpc_ancestor(parent, jnp.where(x.valid, x.kind, 0))
+    anc, root_ok = chase_ancestors(parent, jnp.where(x.valid, x.kind, 0))
     anc_svc = jnp.where(anc >= 0, x.svc[jnp.where(anc >= 0, anc, 0)], 0)
 
     local, remote = x.svc, x.rsvc
@@ -291,7 +391,7 @@ def link_context(x: LinkInput) -> LinkContext:
 
     # rule 1: client span with children defers to its server half;
     # spans in parent cycles never emit (host-traversal reachability)
-    live = x.valid & reaches_root(parent)
+    live = x.valid & root_ok
     live = live & ~((kind == KIND_CLIENT) & has_child)
     # rule 2: kindless spans with both sides known act like clients
     keff = jnp.where(

@@ -265,7 +265,12 @@ def replay(store, wal: WriteAheadLog, from_seq: int = 0) -> int:
                 for s in meta.get("names", []):
                     vocab.span_names.intern(s)
                 for a, b in meta.get("pairs", []):
-                    vocab.key_id(a, b)
+                    # position-faithful: the journal records the exact
+                    # historical pair-id sequence (including any catch-
+                    # all rows the writing build reserved) — re-deriving
+                    # via key_id would shift every id when interning
+                    # rules differ between builds (r4 review finding)
+                    vocab.append_pair(a, b)
             ts = meta.get("ts_range")
             agg.ingest_fused(
                 np.array(fused),  # frombuffer view is read-only
