@@ -1,0 +1,298 @@
+"""Disk-backed raw-span archive (VERDICT r3 order 2).
+
+Three layers: SpanArchive unit behavior (framing, sealing, retention,
+torn-tail recovery), the FULL storage-contract suite with the disk
+archive enabled in both strictness modes (so getTraces/getTrace
+semantics over disk are pinned to the oracle's), and the fast-mode gap
+the order names — after line-rate ingest, ``get_trace`` returns the
+COMPLETE trace for ANY acked trace id, not a 1-in-64 sample.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.fixtures import TRACE, lots_of_spans
+from tests.storage_contract import StorageContract
+from zipkin_tpu import native
+from zipkin_tpu.model.json_v2 import encode_span_list
+from zipkin_tpu.tpu.archive import SpanArchive
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+SMALL = AggConfig(
+    max_services=128, max_keys=512, hll_precision=10,
+    digest_centroids=32, ring_capacity=1 << 14,
+)
+
+
+# -- unit: the archive itself ------------------------------------------------
+
+
+def _batch(n, seed=0, trace_base=1000):
+    rng = np.random.default_rng(seed)
+    payload = b"x" * (n * 10)
+    off = np.arange(n, dtype=np.uint32) * 10
+    ln = np.full(n, 10, np.uint32)
+    tl0 = (trace_base + np.arange(n) // 4).astype(np.uint32)
+    z = np.zeros(n, np.uint32)
+    return dict(
+        payload=bytes(payload), span_off=off, span_len=ln,
+        tl0=tl0, tl1=z, th0=z, th1=z,
+        svc=rng.integers(1, 5, n).astype(np.uint32),
+        rsvc=z, name=rng.integers(1, 9, n).astype(np.uint32),
+        key=rng.integers(1, 9, n).astype(np.uint32),
+        ts_min=np.full(n, 500, np.uint32),
+        dur=rng.integers(1, 1000, n).astype(np.uint64),
+        err=np.zeros(n, bool),
+    )
+
+
+class TestSpanArchiveUnit:
+    def test_roundtrip_live_and_sealed(self, tmp_path):
+        arc = SpanArchive(str(tmp_path / "a"), segment_bytes=1 << 20)
+        b = _batch(16)
+        arc.append_batch(**b)
+        # live (unsealed) lookup
+        raw = arc.fetch_trace_raw(1000, 0, 0, 0, strict=False)
+        assert len(raw) == 4 and all(r == b"x" * 10 for r in raw)
+        arc.flush()  # seal
+        raw = arc.fetch_trace_raw(1000, 0, 0, 0, strict=False)
+        assert len(raw) == 4
+        arc.close()
+
+    def test_strict_high_lane_filter(self, tmp_path):
+        arc = SpanArchive(str(tmp_path / "a"))
+        b = _batch(4)
+        b["th0"] = np.array([7, 7, 8, 8], np.uint32)
+        b["tl0"] = np.full(4, 42, np.uint32)
+        arc.append_batch(**b)
+        assert len(arc.fetch_trace_raw(42, 0, 0, 0, strict=False)) == 4
+        assert len(arc.fetch_trace_raw(42, 0, 7, 0, strict=True)) == 2
+        arc.close()
+
+    def test_retention_drops_oldest_whole_segments(self, tmp_path):
+        arc = SpanArchive(
+            str(tmp_path / "a"), max_bytes=6000, segment_bytes=2000
+        )
+        for i in range(8):
+            arc.append_batch(**_batch(64, seed=i, trace_base=10_000 * (i + 1)))
+        arc.flush()
+        c = arc.counters()
+        assert c["archiveSpansDroppedRetention"] > 0
+        assert c["archiveBytes"] <= 6000 + 4000  # budget + one live slack
+        # newest batch still present, oldest gone
+        assert arc.fetch_trace_raw(80_000, 0, 0, 0, strict=False)
+        assert not arc.fetch_trace_raw(10_000, 0, 0, 0, strict=False)
+        arc.close()
+
+    def test_recovery_rebuilds_unsealed_tail(self, tmp_path):
+        d = str(tmp_path / "a")
+        arc = SpanArchive(d)
+        arc.append_batch(**_batch(8))
+        # simulate a crash: no flush/close; drop the handle
+        arc._live_fh.close()
+        arc._live_fh = None
+        arc2 = SpanArchive(d)
+        assert len(arc2.fetch_trace_raw(1000, 0, 0, 0, strict=False)) == 4
+        arc2.close()
+
+    def test_recovery_truncates_torn_tail(self, tmp_path):
+        d = str(tmp_path / "a")
+        arc = SpanArchive(d)
+        arc.append_batch(**_batch(8))
+        path = arc._live_path
+        arc._live_fh.close()
+        arc._live_fh = None
+        with open(path, "ab") as fh:  # torn partial frame
+            fh.write(b"\x43\x52\x41\x5agarbage")
+        arc2 = SpanArchive(d)
+        assert len(arc2.fetch_trace_raw(1000, 0, 0, 0, strict=False)) == 4
+        arc2.append_batch(**_batch(8, trace_base=5000))  # appends still work
+        assert len(arc2.fetch_trace_raw(5000, 0, 0, 0, strict=False)) == 4
+        arc2.close()
+
+    def test_candidate_scan_filters(self, tmp_path):
+        arc = SpanArchive(str(tmp_path / "a"))
+        b = _batch(16)
+        b["svc"] = np.array([1] * 8 + [2] * 8, np.uint32)
+        b["dur"] = np.arange(1, 17, dtype=np.uint64) * 100
+        arc.append_batch(**b)
+        got = arc.candidate_trace_ids(
+            ts_lo_min=0, ts_hi_min=1 << 30, svc_id=2, min_dur=1500,
+        )
+        assert got  # spans 15,16 (svc 2, dur 1500/1600)
+        assert all(i64 >= 1003 for i64, _ in got)
+        arc.close()
+
+
+# -- contract: the full IT suite over the disk archive ----------------------
+
+
+def disk_store(tmp_path_factory, **kwargs) -> TpuStorage:
+    kwargs.setdefault("config", SMALL)
+    kwargs.setdefault("pad_to_multiple", 256)
+    kwargs.setdefault(
+        "archive_dir", str(tmp_path_factory.mktemp("span_archive"))
+    )
+    # tiny RAM archive: the contract must hold with DISK as the span
+    # store of record, not because the RAM oracle held everything
+    kwargs.setdefault("archive_max_span_count", 8)
+    return TpuStorage(**kwargs)
+
+
+class TestDiskArchiveContract(StorageContract):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path_factory):
+        self._tpf = tmp_path_factory
+
+    def make_storage(self, **kwargs) -> TpuStorage:
+        return disk_store(self._tpf, **kwargs)
+
+
+class TestDiskArchiveContractLenient(StorageContract):
+    @pytest.fixture(autouse=True)
+    def _tmp(self, tmp_path_factory):
+        self._tpf = tmp_path_factory
+
+    def make_storage(self, **kwargs) -> TpuStorage:
+        kwargs.setdefault("strict_trace_id", False)
+        return disk_store(self._tpf, **kwargs)
+
+
+# -- the order's acceptance shape: fast mode, complete traces ---------------
+
+
+@pytest.mark.skipif(not native.available(), reason="native codec unavailable")
+class TestFastModeCompleteTraces:
+    def test_every_acked_trace_readable(self, tmp_path):
+        store = TpuStorage(
+            config=SMALL, pad_to_multiple=256,
+            archive_dir=str(tmp_path / "arc"),
+            archive_max_span_count=8,  # RAM archive can't be the answer
+        )
+        spans = lots_of_spans(4096, seed=3, services=6, span_names=12)
+        n, _ = store.ingest_json_fast(encode_span_list(spans))
+        assert n == 4096
+        by_trace = {}
+        for s in spans:
+            by_trace.setdefault(s.trace_id, []).append(s)
+        # EVERY trace id — not 1 in 64 — returns its complete span set
+        for tid, expect in list(by_trace.items())[::7]:
+            got = store.get_trace(tid).execute()
+            assert len(got) == len(expect), tid
+            assert {g.id for g in got} == {e.id for e in expect}
+        # search over the window works from disk
+        from zipkin_tpu.storage.spi import QueryRequest
+
+        svc = spans[0].local_service_name
+        req = QueryRequest(
+            end_ts=1 << 50, lookback=1 << 50, limit=5, service_name=svc,
+        )
+        out = store.get_traces_query(req).execute()
+        assert 0 < len(out) <= 5
+        assert all(
+            any(s.local_service_name == svc for s in t) for t in out
+        )
+        counters = store.ingest_counters()
+        assert counters["archiveSpansWritten"] == 4096
+        store.close()
+
+    def test_min_duration_and_annotation_query_post_filter(self, tmp_path):
+        store = TpuStorage(
+            config=SMALL, pad_to_multiple=256,
+            archive_dir=str(tmp_path / "arc"), archive_max_span_count=8,
+        )
+        store.ingest_json_fast(encode_span_list(TRACE))
+        from tests.storage_contract import QUERY_TS
+        from zipkin_tpu.storage.spi import QueryRequest
+
+        day = 24 * 3600 * 1000
+        # duration bound rides the index; the error-tag clause is the
+        # exact post-filter (tags are not disk-indexed)
+        req = QueryRequest(
+            end_ts=QUERY_TS, lookback=day, limit=10,
+            service_name="backend", min_duration=50_000,
+            annotation_query={"error": ""},
+        )
+        out = store.get_traces_query(req).execute()
+        assert len(out) == 1
+        req2 = QueryRequest(
+            end_ts=QUERY_TS, lookback=day, limit=10,
+            service_name="backend", annotation_query={"nope": ""},
+        )
+        assert store.get_traces_query(req2).execute() == []
+        store.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="native codec unavailable")
+class TestArchiveRestart:
+    def test_search_survives_process_restart(self, tmp_path):
+        """Segment columns store vocab IDS; the sidecar must bring the id
+        space back on an archive-only restart or every recovered segment
+        is silently unsearchable (r4 review finding)."""
+        d = str(tmp_path / "arc")
+        store = TpuStorage(
+            config=SMALL, pad_to_multiple=256, archive_dir=d,
+            archive_max_span_count=8,
+        )
+        spans = lots_of_spans(512, seed=4, services=3, span_names=6)
+        store.ingest_json_fast(encode_span_list(spans))
+        svc = spans[0].local_service_name
+        tid = spans[100].trace_id
+        store.close()
+
+        # "restart": a fresh store over the same dir, empty vocab
+        store2 = TpuStorage(
+            config=SMALL, pad_to_multiple=256, archive_dir=d,
+            archive_max_span_count=8,
+        )
+        from zipkin_tpu.storage.spi import QueryRequest
+
+        out = store2.get_traces_query(QueryRequest(
+            end_ts=1 << 50, lookback=1 << 50, limit=5, service_name=svc,
+        )).execute()
+        assert out, "pre-restart spans must stay searchable"
+        got = store2.get_trace(tid).execute()
+        assert got and all(s.trace_id == tid for s in got)
+        assert svc in store2.get_service_names().execute()
+        store2.close()
+
+    def test_retention_race_returns_partial_not_error(self, tmp_path):
+        """A query holding a views() snapshot must survive retention
+        deleting a segment under it (reads ride the retained fd)."""
+        from zipkin_tpu.tpu.archive import SpanArchive
+        import numpy as np
+
+        arc = SpanArchive(
+            str(tmp_path / "a"), max_bytes=1 << 30, segment_bytes=4096
+        )
+        n = 64
+        payload = b"y" * (n * 10)
+        base = dict(
+            span_off=np.arange(n, dtype=np.uint32) * 10,
+            span_len=np.full(n, 10, np.uint32),
+            tl1=np.zeros(n, np.uint32), th0=np.zeros(n, np.uint32),
+            th1=np.zeros(n, np.uint32),
+            svc=np.ones(n, np.uint32), rsvc=np.zeros(n, np.uint32),
+            name=np.ones(n, np.uint32), key=np.ones(n, np.uint32),
+            ts_min=np.full(n, 5, np.uint32),
+            dur=np.ones(n, np.uint64), err=np.zeros(n, bool),
+        )
+        arc.append_batch(payload=payload, tl0=np.full(n, 7, np.uint32), **base)
+        arc.flush()
+        views = arc.views()  # snapshot BEFORE retention
+        # force retention to delete the sealed segment
+        arc.max_bytes = 1
+        arc.append_batch(payload=payload, tl0=np.full(n, 9, np.uint32), **base)
+        arc.flush()
+        import os as _os
+
+        assert not _os.path.exists(views[0][2].path)
+        # the snapshot still reads the deleted segment via its fd
+        raw = arc.fetch_trace_raw(7, 0, 0, 0, strict=False, views=views)
+        assert len(raw) == n and raw[0] == b"y" * 10
+        arc.close()

@@ -195,9 +195,17 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     def _gather_recluster(local):
         """all_gather per-shard [K, C, 2] digests over ICI and recluster
         row-wise into one [K, C, 2] — shared by every digest read so the
-        pending and no-pending variants stay bit-identical."""
+        pending and no-pending variants stay bit-identical.
+
+        On a ONE-shard mesh this is the identity: a shard's digest rows
+        are already complete mean-sorted digests, and the r3 SLO capture
+        showed the pointless self-merge was most of the 35.2 ms
+        single-shard percentile read (VERDICT r3 order 7). n_shards is a
+        trace-time constant, so each mesh compiles the right program."""
         from zipkin_tpu.ops import tdigest
 
+        if n_shards == 1:
+            return local
         allc = jax.lax.all_gather(local, SHARD_AXIS)  # [D, K, C, 2]
         d = allc.shape[0]
         k = config.max_keys

@@ -72,6 +72,9 @@ def build_storage(config: ServerConfig) -> StorageComponent:
             checkpoint_dir=config.tpu_checkpoint_dir,
             wal_dir=config.tpu_wal_dir,
             wal_fsync=config.tpu_wal_fsync,
+            archive_dir=config.tpu_archive_dir,
+            archive_max_bytes=config.tpu_archive_max_bytes,
+            archive_segment_bytes=config.tpu_archive_segment_bytes,
             config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
             fast_archive_sample=config.tpu_fast_archive_sample,
             **common,

@@ -69,6 +69,12 @@ class ServerConfig:
     tpu_mp_workers: int = 0  # >0: multi-process parse tier (mp_ingest)
     tpu_checkpoint_dir: Optional[str] = None
     tpu_wal_dir: Optional[str] = None  # append-log of fused batches (tpu/wal.py)
+    # disk-backed raw-span archive (tpu/archive.py): every ingested
+    # span's raw JSON retained behind a trace-id index; retention is the
+    # byte budget (oldest segments dropped whole)
+    tpu_archive_dir: Optional[str] = None
+    tpu_archive_max_bytes: int = 2 << 30
+    tpu_archive_segment_bytes: int = 64 << 20
     # fsync each WAL append: durability vs host/power failure, at a
     # per-batch fsync cost. Off = page-cache durability (process crash
     # only — the kill -9 soak's boundary; see ARCHITECTURE.md).
@@ -110,6 +116,13 @@ class ServerConfig:
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
             tpu_wal_dir=os.environ.get("TPU_WAL_DIR") or None,
             tpu_wal_fsync=_env_bool("TPU_WAL_FSYNC", False),
+            tpu_archive_dir=os.environ.get("TPU_ARCHIVE_DIR") or None,
+            tpu_archive_max_bytes=_env_int(
+                "TPU_ARCHIVE_MAX_BYTES", 2 << 30
+            ),
+            tpu_archive_segment_bytes=_env_int(
+                "TPU_ARCHIVE_SEGMENT_BYTES", 64 << 20
+            ),
             tpu_snapshot_interval_s=_env_float("TPU_SNAPSHOT_INTERVAL_S", 300.0),
             tpu_agg=_env_agg(),
         )

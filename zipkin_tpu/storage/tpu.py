@@ -30,6 +30,9 @@ class TpuStorage(_CoreTpuStorage):
         fast_archive_sample: int = 64,
         wal_dir: Optional[str] = None,
         wal_fsync: bool = False,
+        archive_dir: Optional[str] = None,
+        archive_max_bytes: int = 2 << 30,
+        archive_segment_bytes: int = 64 << 20,
     ) -> None:
         mesh = None
         if num_devices is not None:
@@ -45,6 +48,9 @@ class TpuStorage(_CoreTpuStorage):
             archive_max_span_count=max_span_count,
             pad_to_multiple=min(batch_size, 1024),
             fast_archive_sample=fast_archive_sample,
+            archive_dir=archive_dir,
+            archive_max_bytes=archive_max_bytes,
+            archive_segment_bytes=archive_segment_bytes,
         )
         import threading
 

@@ -1,0 +1,452 @@
+"""Disk-backed raw-span archive: the trace STORE behind the sketches.
+
+The reference is a trace store first — every ingested span stays
+queryable for the retention window (``zipkin2/storage/InMemoryStorage``
+semantics; the row backends in SURVEY.md §2.3). The r3 rebuild's fast
+mode kept only a 1-in-64 trace sample in RAM, so ``GET
+/api/v2/trace/{id}`` returned nothing for 63 of 64 traces (VERDICT r3
+order 2). This module closes that gap for the line-rate path:
+
+- **Write path** (once per ingest batch, sequential IO): the raw JSON
+  payload is appended to the current segment file inside a
+  self-describing frame, together with per-span byte extents (the C
+  parser records them — ``native/span_json.c``) and the columnar search
+  fields (trace-id lanes, service/name/key ids, timestamp, duration,
+  error). No re-encoding, no per-span work.
+- **Segments** roll at a size bound and are SEALED with two sidecar
+  ``.npy`` index files: span rows sorted by the span's low-64 trace id,
+  plus that sorted id column. Sealed indexes are read back
+  ``mmap_mode='r'`` — lookups touch pages, not RSS, so memory stays
+  flat however much history is on disk.
+- **Reads**: ``get_trace`` binary-searches each segment's sorted id
+  column (newest first) and preads exactly the matching spans' byte
+  extents; strict-trace-id mode verifies the full 128-bit id from the
+  stored high lanes. ``get_traces`` scans segment columns newest-first
+  with vectorized candidate masks (service/span-name/remote-service/
+  duration bounds), then decodes candidate TRACES and applies the exact
+  ``QueryRequest.test`` predicate — annotationQuery and any other
+  non-indexed clause are exact by post-filtering, the same
+  fetch-then-filter shape the reference's row backends use.
+- **Retention** is a disk-byte budget (``max_bytes``): oldest segments
+  are deleted whole, so the queryable window is "whatever the budget
+  holds" — the bounded analog of the reference's TTL'd daily indexes.
+- **Recovery**: frames carry a magic + CRC; an unsealed tail segment is
+  rebuilt by scanning its frames on boot (a torn final frame is
+  truncated, matching the WAL's torn-tail rule).
+
+Columns per span (u32 lanes): tl0 tl1 th0 th1 | off len | svc<<16|rsvc
+| name | key | ts_min | dur<<1|err. 44 B/span of index beside the raw
+JSON bytes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = 0x5A415243  # "ZARC"
+_FRAME = struct.Struct("<IIII")  # magic, n_spans, payload_len, payload_crc
+COLS = 11  # u32 lanes per span (see module docstring)
+
+
+def _id64(tl0: np.ndarray, tl1: np.ndarray) -> np.ndarray:
+    """The span's low-64 trace id as one u64 sort/search key (EXACT, not
+    a hash — lenient trace-id matching is exact low-64 equality)."""
+    return (tl1.astype(np.uint64) << np.uint64(32)) | tl0.astype(np.uint64)
+
+
+class _Segment:
+    """One sealed segment: data file + mmap'd sorted index sidecars."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.ids = np.load(path + ".ids.npy", mmap_mode="r")  # [n] u64 sorted
+        self.cols = np.load(path + ".cols.npy", mmap_mode="r")  # [n, COLS] u32
+        # a retained fd: reads survive retention's unlink (queries that
+        # snapshotted views() before the delete still resolve)
+        self._fd = os.open(path, os.O_RDONLY)
+
+    def pread(self, off: int, ln: int) -> bytes:
+        return os.pread(self._fd, ln, off)
+
+    @property
+    def n(self) -> int:
+        return int(self.ids.shape[0])
+
+    def bytes_used(self) -> int:
+        total = 0
+        for p in (self.path, self.path + ".ids.npy", self.path + ".cols.npy"):
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+        return total
+
+    def close(self) -> None:
+        # numpy mmaps close with GC; drop references eagerly
+        self.ids = None
+        self.cols = None
+        if getattr(self, "_fd", None) is not None:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+
+    def __del__(self):  # pragma: no cover - GC finalizer
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class SpanArchive:
+    """Bounded disk archive of raw span JSON with a trace-id index."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_bytes: int = 2 << 30,
+        segment_bytes: int = 64 << 20,
+    ) -> None:
+        if segment_bytes > (3 << 30):
+            # span offsets are segment-absolute u32; a segment may
+            # overshoot its bound by one batch (~64MB), so cap well
+            # below 4GiB instead of silently wrapping extents
+            raise ValueError(
+                f"segment_bytes ({segment_bytes}) must be <= 3GiB "
+                "(u32 segment-absolute offsets)"
+            )
+        self.directory = directory
+        self.max_bytes = max_bytes
+        self.segment_bytes = segment_bytes
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._sealed: List[_Segment] = []  # oldest -> newest
+        self._live_fh = None
+        self._live_path: Optional[str] = None
+        self._live_bytes = 0
+        self._live_rows: List[np.ndarray] = []  # [n, COLS] u32 chunks
+        self._seg_idx = 0
+        self._closed = False
+        self.spans_written = 0
+        self.spans_dropped_retention = 0
+        self._recover()
+
+    # -- write side ------------------------------------------------------
+
+    def append_batch(
+        self,
+        payload: bytes,
+        span_off: np.ndarray,
+        span_len: np.ndarray,
+        tl0: np.ndarray,
+        tl1: np.ndarray,
+        th0: np.ndarray,
+        th1: np.ndarray,
+        svc: np.ndarray,
+        rsvc: np.ndarray,
+        name: np.ndarray,
+        key: np.ndarray,
+        ts_min: np.ndarray,
+        dur: np.ndarray,
+        err: np.ndarray,
+    ) -> None:
+        """Append one parsed batch: the raw payload plus per-span index
+        columns. All arrays length n; offsets index into ``payload``."""
+        n = int(span_off.shape[0])
+        if n == 0:
+            return
+        rows = np.empty((n, COLS), np.uint32)
+        rows[:, 0] = tl0
+        rows[:, 1] = tl1
+        rows[:, 2] = th0
+        rows[:, 3] = th1
+        rows[:, 4] = span_off
+        rows[:, 5] = span_len
+        rows[:, 6] = (svc.astype(np.uint32) << np.uint32(16)) | (
+            rsvc.astype(np.uint32) & np.uint32(0xFFFF)
+        )
+        rows[:, 7] = name.astype(np.uint32)
+        rows[:, 8] = key.astype(np.uint32)
+        rows[:, 9] = ts_min.astype(np.uint32)
+        rows[:, 10] = (
+            np.minimum(dur.astype(np.uint64), (1 << 31) - 1).astype(np.uint32)
+            << np.uint32(1)
+        ) | err.astype(np.uint32)
+        frame = _FRAME.pack(_MAGIC, n, len(payload), zlib.crc32(payload))
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("archive is closed")
+            fh = self._live_file()
+            base = self._live_bytes + _FRAME.size + rows.nbytes
+            # offsets become absolute within the segment's data file
+            rows[:, 4] += np.uint32(base)
+            fh.write(frame)
+            fh.write(rows.tobytes())
+            fh.write(payload)
+            fh.flush()
+            self._live_bytes = base + len(payload)
+            self._live_rows.append(rows)
+            self.spans_written += n
+            if self._live_bytes >= self.segment_bytes:
+                self._seal_live()
+                self._enforce_retention()
+
+    def _live_file(self):
+        if self._live_fh is None:
+            self._live_path = os.path.join(
+                self.directory, f"arc-{self._seg_idx:08d}.dat"
+            )
+            self._seg_idx += 1
+            self._live_fh = open(self._live_path, "ab")
+            self._live_bytes = os.path.getsize(self._live_path)
+        return self._live_fh
+
+    def _seal_live(self) -> None:
+        """Sort the live rows by low-64 trace id and write the sidecars;
+        reopen the segment read-only as mmap."""
+        if self._live_fh is None:
+            return
+        self._live_fh.close()
+        self._live_fh = None
+        rows = (
+            np.concatenate(self._live_rows)
+            if self._live_rows
+            else np.empty((0, COLS), np.uint32)
+        )
+        self._live_rows = []
+        ids = _id64(rows[:, 0], rows[:, 1])
+        order = np.argsort(ids, kind="stable")
+        np.save(self._live_path + ".ids.npy", ids[order])
+        np.save(self._live_path + ".cols.npy", rows[order])
+        self._sealed.append(_Segment(self._live_path))
+        self._live_path = None
+        self._live_bytes = 0
+
+    def _enforce_retention(self) -> None:
+        total = sum(s.bytes_used() for s in self._sealed) + self._live_bytes
+        while len(self._sealed) > 1 and total > self.max_bytes:
+            old = self._sealed.pop(0)
+            total -= old.bytes_used()
+            self.spans_dropped_retention += old.n
+            # do NOT close: a query holding a views() snapshot may still
+            # read through the segment's mmaps/fd — POSIX keeps unlinked
+            # files readable until the last reference drops (GC closes)
+            for suffix in ("", ".ids.npy", ".cols.npy"):
+                try:
+                    os.remove(old.path + suffix)
+                except OSError:
+                    pass
+
+    def flush(self) -> None:
+        """Seal the live segment so its spans are index-served (tests,
+        shutdown). Cheap no-op when nothing is live."""
+        with self._lock:
+            if self._live_rows or self._live_fh is not None:
+                self._seal_live()
+                self._enforce_retention()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if self._live_rows or self._live_fh is not None:
+                self._seal_live()
+            self._closed = True
+            for s in self._sealed:
+                s.close()
+
+    # -- recovery --------------------------------------------------------
+
+    def _recover(self) -> None:
+        names = sorted(
+            f for f in os.listdir(self.directory)
+            if f.startswith("arc-") and f.endswith(".dat")
+        )
+        for f in names:
+            path = os.path.join(self.directory, f)
+            self._seg_idx = max(
+                self._seg_idx, int(f[len("arc-"):-len(".dat")]) + 1
+            )
+            if os.path.exists(path + ".ids.npy"):
+                try:
+                    self._sealed.append(_Segment(path))
+                    continue
+                except Exception:
+                    logger.warning("archive: bad sidecars for %s", path)
+            # unsealed tail: rebuild rows by scanning frames; truncate a
+            # torn final frame (the WAL's torn-tail rule)
+            rows, good = self._scan_frames(path)
+            if rows:
+                self._live_path = path
+                self._live_fh = open(path, "ab")
+                if good < os.path.getsize(path):
+                    self._live_fh.truncate(good)
+                self._live_bytes = good
+                self._live_rows = rows
+                self.spans_written += int(sum(r.shape[0] for r in rows))
+            else:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def _scan_frames(self, path: str) -> Tuple[List[np.ndarray], int]:
+        rows: List[np.ndarray] = []
+        good = 0
+        size = os.path.getsize(path)
+        with open(path, "rb") as fh:
+            while True:
+                hdr = fh.read(_FRAME.size)
+                if len(hdr) < _FRAME.size:
+                    break
+                magic, n, plen, crc = _FRAME.unpack(hdr)
+                if magic != _MAGIC:
+                    break
+                need = n * COLS * 4 + plen
+                body = fh.read(need)
+                if len(body) < need:
+                    break
+                if zlib.crc32(body[n * COLS * 4:]) != crc:
+                    break
+                rows.append(
+                    np.frombuffer(
+                        body, np.uint32, count=n * COLS
+                    ).reshape(n, COLS).copy()
+                )
+                good += _FRAME.size + need
+        if good < size:
+            logger.warning(
+                "archive: truncated torn tail of %s at %d (was %d)",
+                path, good, size,
+            )
+        return rows, good
+
+    # -- read side -------------------------------------------------------
+
+    def views(self):
+        """(ids, cols, data_path) per segment, NEWEST first, including a
+        sorted view of the live segment. Query paths that touch several
+        traces snapshot this ONCE — the live view sorts its rows on
+        construction, so per-trace re-snapshots would re-sort per call
+        (measured 1881 argsorts for one search before this was shared)."""
+        with self._lock:
+            out = []
+            if self._live_rows and self._live_path:
+                rows = np.concatenate(self._live_rows)
+                ids = _id64(rows[:, 0], rows[:, 1])
+                order = np.argsort(ids, kind="stable")
+                out.append((ids[order], rows[order], self._live_path))
+            for seg in reversed(self._sealed):
+                # the SEGMENT object (not its path): its retained fd
+                # keeps reads working after retention unlinks the file
+                out.append((seg.ids, seg.cols, seg))
+            return out
+
+    def _read_spans(self, src, rows: np.ndarray) -> List[bytes]:
+        """``src`` is a _Segment (sealed: retained fd) or a path string
+        (live segment: never deleted while live)."""
+        if isinstance(src, _Segment):
+            return [
+                src.pread(int(off), int(ln)) for off, ln in rows[:, 4:6]
+            ]
+        out = []
+        try:
+            with open(src, "rb") as fh:
+                for off, ln in rows[:, 4:6]:
+                    fh.seek(int(off))
+                    out.append(fh.read(int(ln)))
+        except FileNotFoundError:  # pragma: no cover - live never deleted
+            return []
+        return out
+
+    def fetch_trace_raw(
+        self, tl0: int, tl1: int, th0: int, th1: int, strict: bool,
+        views=None,
+    ) -> List[bytes]:
+        """Raw JSON slices of every archived span whose trace id matches
+        (exact low-64; high-64 also compared when ``strict``)."""
+        want = np.uint64((tl1 << 32) | tl0)
+        slices: List[bytes] = []
+        for ids, cols, path in views if views is not None else self.views():
+            lo = int(np.searchsorted(ids, want, side="left"))
+            hi = int(np.searchsorted(ids, want, side="right"))
+            if hi <= lo:
+                continue
+            rows = np.asarray(cols[lo:hi])
+            if strict:
+                rows = rows[(rows[:, 2] == th0) & (rows[:, 3] == th1)]
+            if rows.shape[0]:
+                slices.extend(self._read_spans(path, rows))
+        return slices
+
+    def candidate_trace_ids(
+        self,
+        *,
+        ts_lo_min: int,
+        ts_hi_min: int,
+        svc_id: Optional[int] = None,
+        rsvc_id: Optional[int] = None,
+        name_id: Optional[int] = None,
+        min_dur: Optional[int] = None,
+        max_dur: Optional[int] = None,
+        limit: int = 1000,
+        views=None,
+    ) -> List[Tuple[int, int]]:
+        """Distinct (id64_low, ts) candidates matching the INDEXED
+        predicates, newest-first, scanning newest segments first and
+        stopping once ``limit`` distinct traces matched (so a narrow
+        recent query never reads cold segments). Non-indexed clauses
+        (annotationQuery) are the caller's exact post-filter."""
+        seen: Dict[int, int] = {}
+        for ids, cols, _ in views if views is not None else self.views():
+            cols = np.asarray(cols)
+            mask = (cols[:, 9] >= ts_lo_min) & (cols[:, 9] <= ts_hi_min)
+            if svc_id is not None:
+                mask &= (cols[:, 6] >> 16) == svc_id
+            if rsvc_id is not None:
+                mask &= (cols[:, 6] & 0xFFFF) == rsvc_id
+            if name_id is not None:
+                mask &= cols[:, 7] == name_id
+            dur = cols[:, 10] >> 1
+            clamp = (1 << 31) - 1  # stored durations clamp here
+            if min_dur is not None:
+                mask &= dur >= max(min(min_dur, clamp), 1)  # dur 0 = absent
+            if max_dur is not None:
+                mask &= (dur <= min(max_dur, clamp)) & (dur > 0)
+            hit = np.nonzero(mask)[0]
+            if hit.size == 0:
+                continue
+            hit_ids = _id64(cols[hit, 0], cols[hit, 1])
+            hit_ts = cols[hit, 9]
+            for i64, ts in zip(hit_ids.tolist(), hit_ts.tolist()):
+                prev = seen.get(i64)
+                if prev is None or ts > prev:
+                    seen[i64] = ts
+            if len(seen) >= limit:
+                break
+        # newest first, TRUNCATED to the limit: a single big segment can
+        # contribute far more matches than the cap before the loop
+        # breaks, and callers pay a trace fetch per returned candidate
+        return sorted(seen.items(), key=lambda kv: -kv[1])[:limit]
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "archiveSpansWritten": self.spans_written,
+                "archiveSpansDroppedRetention": self.spans_dropped_retention,
+                "archiveSegments": len(self._sealed)
+                + (1 if self._live_rows else 0),
+                "archiveBytes": sum(s.bytes_used() for s in self._sealed)
+                + self._live_bytes,
+            }

@@ -254,6 +254,15 @@ class MultiProcessIngester:
 
         if not native.available():
             raise RuntimeError("native codec unavailable; MP tier needs it")
+        if getattr(store, "_disk", None) is not None:
+            # workers ship only the packed wire + sampled slices; the
+            # raw payload never reaches the dispatcher, so the disk
+            # archive cannot cover MP-ingested spans
+            logger.warning(
+                "MP ingest tier does not feed the disk span archive; "
+                "traces ingested here are not raw-archived (use the "
+                "sync fast path for archive-complete ingest)"
+            )
         self.store = store
         self.workers = workers
         self._sampler = sampler

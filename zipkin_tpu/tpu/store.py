@@ -66,6 +66,9 @@ class TpuStorage(
         archive_max_span_count: int = 500_000,
         pad_to_multiple: int = 1024,
         fast_archive_sample: int = 64,
+        archive_dir: Optional[str] = None,
+        archive_max_bytes: int = 2 << 30,
+        archive_segment_bytes: int = 64 << 20,
     ) -> None:
         from zipkin_tpu.parallel.sharded import ShardedAggregator
 
@@ -107,8 +110,42 @@ class TpuStorage(
                 f"pad_to_multiple ({pad_to_multiple})"
             )
         self._closed = False
+        # disk-backed raw-span archive (VERDICT r3 order 2): when set,
+        # EVERY ingested span's raw JSON is retained on disk behind a
+        # trace-id index (retention = a disk-byte budget), so fast-mode
+        # get_trace returns the COMPLETE trace for any acked id in the
+        # window — not the 1-in-64 RAM sample. See tpu/archive.py.
+        self._disk = None
+        self._archive_vocab_path = None
+        self._archive_vocab_persisted = 0
+        if archive_dir:
+            from zipkin_tpu.tpu.archive import SpanArchive
+
+            self._disk = SpanArchive(
+                archive_dir,
+                max_bytes=archive_max_bytes,
+                segment_bytes=archive_segment_bytes,
+            )
+            import os as _os2
+
+            self._archive_vocab_path = _os2.path.join(
+                archive_dir, "vocab.json"
+            )
+        # remote services per service (svc_id -> set of rsvc ids) and the
+        # set of ids seen as a LOCAL service: the disk index serves
+        # search, but these tiny host maps answer getServiceNames /
+        # getRemoteServiceNames without a segment scan. The vocab alone
+        # cannot answer either — remote names intern into the same
+        # services table, and the reference lists LOCAL names only.
+        self._remote_by_svc: dict = {}
+        self._local_svc_ids: set = set()
+        self._names_lock = threading.Lock()
         # fast-mode archive sampling: 1 in N traces keeps full raw spans
         # (0 disables). Trace-affine so sampled traces are COMPLETE.
+        # Kept CONFIGURED even with the disk archive on: the sync fast
+        # path then skips RAM sampling (disk holds everything), but the
+        # MP tier's workers — which cannot feed the disk archive — still
+        # sample at this rate so MP-ingested traces stay readable.
         self._fast_archive_every = fast_archive_sample
         # interning id-space coherence: the C-side vocab (fast path) and
         # the Python vocab (object path) assign ids sequentially; any
@@ -133,6 +170,85 @@ class TpuStorage(
             _os.environ.get("TPU_DEPS_MAX_STALE_MS", 5000.0)
         )
         self._deps_cache: dict = {}
+        # archive-only restart: segment columns store vocab IDS, so the
+        # ids must survive the process or every recovered segment becomes
+        # unsearchable. A snapshot restore (storage/tpu.py) replaces the
+        # vocab wholesale afterwards — its id stream is the same stream,
+        # so both sources agree on every id they share; WAL replay then
+        # re-adds any post-snapshot tail (r4 review finding).
+        self._load_archive_vocab()
+
+    def _load_archive_vocab(self) -> None:
+        if self._archive_vocab_path is None:
+            return
+        import json
+        import os as _os
+
+        if not _os.path.exists(self._archive_vocab_path):
+            return
+        if len(self.vocab.services) > 1 or self.vocab.num_keys > 1:
+            return  # a live vocab wins (tests reuse dirs)
+        try:
+            with open(self._archive_vocab_path) as f:
+                meta = json.load(f)
+        except Exception:  # pragma: no cover - torn sidecar
+            logger.warning("archive vocab sidecar unreadable; search over "
+                           "recovered segments will miss pre-restart spans")
+            return
+        v = self.vocab
+        v.services._names = list(meta["services"])
+        v.services._ids = {
+            n: i for i, n in enumerate(meta["services"]) if i
+        }
+        v.span_names._names = list(meta["span_names"])
+        v.span_names._ids = {
+            n: i for i, n in enumerate(meta["span_names"]) if i
+        }
+        v._key_list = [tuple(k) for k in meta["keys"]]
+        v._keys = {tuple(k): i for i, k in enumerate(meta["keys"]) if i}
+        with self._names_lock:
+            self._local_svc_ids = set(meta.get("local_svc_ids", ()))
+            self._remote_by_svc = {
+                int(k): set(vv)
+                for k, vv in meta.get("remote_by_svc", {}).items()
+            }
+        self._archive_vocab_persisted = len(v._key_list) + len(
+            v.services._names
+        ) + len(v.span_names._names)
+
+    def _persist_archive_vocab(self) -> None:
+        """Write the vocab sidecar when it grew since the last write
+        (atomic rename; amortized to vocab growth, which is bounded)."""
+        if self._archive_vocab_path is None:
+            return
+        import json
+        import os as _os
+        import tempfile as _tempfile
+
+        v = self.vocab
+        with self._intern_lock:
+            size = len(v._key_list) + len(v.services._names) + len(
+                v.span_names._names
+            )
+            if size == self._archive_vocab_persisted:
+                return
+            with self._names_lock:
+                meta = {
+                    "services": list(v.services._names),
+                    "span_names": list(v.span_names._names),
+                    "keys": [list(k) for k in v._key_list],
+                    "local_svc_ids": sorted(self._local_svc_ids),
+                    "remote_by_svc": {
+                        str(k): sorted(vv)
+                        for k, vv in self._remote_by_svc.items()
+                    },
+                }
+            self._archive_vocab_persisted = size
+        d = _os.path.dirname(self._archive_vocab_path)
+        fd, tmp = _tempfile.mkstemp(dir=d, suffix=".json.tmp")
+        with _os.fdopen(fd, "w") as f:
+            json.dump(meta, f)
+        _os.replace(tmp, self._archive_vocab_path)
 
     # -- SPI factories ---------------------------------------------------
 
@@ -155,6 +271,8 @@ class TpuStorage(
             if not spans:
                 return
             self._archive.accept(spans).execute()
+            if self._disk is not None:
+                self._disk_append_spans(spans)
             # chunk: a giant POST must not exceed the device batch bound
             # (state transitions serialize on the aggregator's own lock)
             for lo in range(0, len(spans), self.max_batch):
@@ -165,6 +283,59 @@ class TpuStorage(
                 self.agg.ingest(cols)
 
         return Call.of(run)
+
+    def _disk_append_spans(self, spans: Sequence[Span]) -> None:
+        """Object-path mirror of :meth:`_disk_append_parsed`: encode each
+        span once (the slow path already pays per-span object costs) so
+        the disk archive is complete whichever ingest path ran. The
+        intern lock covers ONLY the vocab pass — encoding and the disk
+        write happen outside it, so a large object-path POST cannot
+        stall line-rate ingest behind its IO (r4 review finding)."""
+        from zipkin_tpu.internal.hex import normalize_trace_id
+        from zipkin_tpu.model import json_v2
+
+        n = len(spans)
+        parts: List[bytes] = []
+        off = np.zeros(n, np.uint32)
+        ln = np.zeros(n, np.uint32)
+        lanes = np.zeros((n, 4), np.uint32)  # tl0 tl1 th0 th1
+        svc = np.zeros(n, np.uint32)
+        rsvc = np.zeros(n, np.uint32)
+        name = np.zeros(n, np.uint32)
+        key = np.zeros(n, np.uint32)
+        ts_min = np.zeros(n, np.uint32)
+        dur = np.zeros(n, np.uint64)
+        err = np.zeros(n, bool)
+        pos = 0
+        for i, s in enumerate(spans):
+            enc = json_v2.encode_span(s)
+            parts.append(enc)
+            off[i] = pos
+            ln[i] = len(enc)
+            pos += len(enc)
+            full = int(normalize_trace_id(s.trace_id), 16)
+            lo64, hi64 = full & ((1 << 64) - 1), full >> 64
+            lanes[i] = (
+                lo64 & 0xFFFFFFFF, lo64 >> 32,
+                hi64 & 0xFFFFFFFF, hi64 >> 32,
+            )
+            ts_min[i] = (s.timestamp or 0) // 60_000_000
+            dur[i] = s.duration or 0
+            err[i] = "error" in (s.tags or {})
+        with self._intern_lock:
+            for i, s in enumerate(spans):
+                sid = self.vocab.services.intern(s.local_service_name)
+                rid = self.vocab.services.intern(s.remote_service_name)
+                nid = self.vocab.span_names.intern(s.name)
+                svc[i], rsvc[i], name[i] = sid, rid, nid
+                key[i] = self.vocab.key_id(sid, nid)
+        self._track_remotes(svc, rsvc)
+        self._disk.append_batch(
+            b"".join(parts), off, ln,
+            lanes[:, 0], lanes[:, 1], lanes[:, 2], lanes[:, 3],
+            svc, rsvc, name, key, ts_min, dur, err,
+        )
+        self._persist_archive_vocab()
 
     def ingest_json_fast(self, data: bytes, sampler=None):
         """Line-rate ingest: raw JSON v2 bytes -> device aggregates via the
@@ -237,9 +408,70 @@ class TpuStorage(
         return n, dropped, chunks
 
     def _fast_dispatch(self, parsed, cols) -> None:
-        """Device half of the fast path: sampled archive + sharded ingest."""
-        self._archive_fast_sample(parsed, parsed.n)
+        """Device half of the fast path: raw-span archive + sharded ingest."""
+        if self._disk is not None:
+            self._disk_append_parsed(parsed)
+        else:
+            self._archive_fast_sample(parsed, parsed.n)
         self.agg.ingest(cols)
+
+    def _disk_append_parsed(self, parsed) -> None:
+        """Write one fast-path chunk's raw spans + index columns to the
+        disk archive. A chunk's spans are contiguous in the payload, so
+        only that byte range is written (no duplication when a giant
+        payload chunks)."""
+        n = parsed.n
+        if n == 0:
+            return
+        off = parsed.span_off[:n].astype(np.uint64)
+        ln = parsed.span_len[:n].astype(np.uint64)
+        lo = int(off[0])
+        hi = int((off + ln).max())
+        span_bytes = int(ln.sum())
+        if span_bytes < (hi - lo) * 95 // 100:
+            # the sampler dropped spans between the kept ones: archiving
+            # the contiguous range would persist the dropped spans' raw
+            # bytes as unindexed garbage (at rate 0.1, ~90% of every
+            # segment). Compact to exactly the kept slices.
+            data = parsed.data
+            parts = [
+                bytes(data[int(o) : int(o) + int(l)])
+                for o, l in zip(off.tolist(), ln.tolist())
+            ]
+            payload = b"".join(parts)
+            new_off = np.concatenate(
+                [[0], np.cumsum(ln[:-1])]
+            ).astype(np.uint32)
+        else:
+            payload = bytes(parsed.data[lo:hi])
+            new_off = (off - lo).astype(np.uint32)
+        svc = parsed.svc_id[:n]
+        rsvc = parsed.rsvc_id[:n]
+        self._track_remotes(svc, rsvc)
+        self._disk.append_batch(
+            payload,
+            new_off, parsed.span_len[:n],
+            parsed.tl0[:n], parsed.tl1[:n], parsed.th0[:n], parsed.th1[:n],
+            svc, rsvc, parsed.name_id[:n], parsed.key_id[:n],
+            (parsed.ts_us[:n] // 60_000_000).astype(np.uint32),
+            np.where(parsed.has_dur[:n], parsed.dur_us[:n], 0).astype(
+                np.uint64
+            ),
+            parsed.err[:n],
+        )
+        self._persist_archive_vocab()
+
+    def _track_remotes(self, svc: np.ndarray, rsvc: np.ndarray) -> None:
+        pairs = np.unique(
+            svc.astype(np.uint64) << np.uint64(32) | rsvc.astype(np.uint64)
+        )
+        with self._names_lock:
+            for p in pairs.tolist():
+                s, r = p >> 32, p & 0xFFFFFFFF
+                if s:
+                    self._local_svc_ids.add(int(s))
+                if s and r:
+                    self._remote_by_svc.setdefault(int(s), set()).add(int(r))
 
     def warm(self, data: bytes) -> None:
         """Compile every ingest-path program against a real payload (the
@@ -294,25 +526,217 @@ class TpuStorage(
         if spans:
             self._archive.accept(spans).execute()
 
-    # -- raw trace reads: host archive -----------------------------------
+    # -- raw trace reads: disk archive + host archive ---------------------
+
+    def _disk_trace_spans(self, trace_id: str) -> List[Span]:
+        """Decode every archived span matching ``trace_id`` under the
+        store's strictness (exact low-64 match; high lanes + the decoded
+        id string verified when strict)."""
+        from zipkin_tpu.internal.hex import normalize_trace_id
+        from zipkin_tpu.model import json_v2
+
+        normalized = normalize_trace_id(trace_id)
+        full = int(normalized, 16)
+        lo, hi = full & ((1 << 64) - 1), full >> 64
+        slices = self._disk.fetch_trace_raw(
+            lo & 0xFFFFFFFF, lo >> 32, hi & 0xFFFFFFFF, hi >> 32,
+            strict=self.strict_trace_id,
+        )
+        spans = []
+        for raw in slices:
+            try:
+                s = json_v2.decode_one_span(raw)
+            except Exception:  # pragma: no cover - parser accepted it
+                continue
+            if self.strict_trace_id and normalize_trace_id(
+                s.trace_id
+            ) != normalized:
+                continue
+            spans.append(s)
+        return spans
 
     def get_trace(self, trace_id: str) -> Call[List[Span]]:
-        return self._archive.get_trace(trace_id)
+        if self._disk is None:
+            return self._archive.get_trace(trace_id)
+
+        def run() -> List[Span]:
+            from zipkin_tpu.internal.span_node import merge_trace
+
+            spans = self._disk_trace_spans(trace_id)
+            spans += self._archive.get_trace(trace_id).execute()
+            return merge_trace(spans)
+
+        return Call.of(run)
 
     def get_traces(self, trace_ids: Sequence[str]) -> Call[List[List[Span]]]:
-        return self._archive.get_traces(trace_ids)
+        if self._disk is None:
+            return self._archive.get_traces(trace_ids)
+
+        def run() -> List[List[Span]]:
+            from zipkin_tpu.storage.spi import trace_id_key
+
+            out, seen = [], set()
+            for tid in trace_ids:
+                key = trace_id_key(tid, self.strict_trace_id)
+                if key in seen:
+                    continue
+                seen.add(key)
+                spans = self.get_trace(tid).execute()
+                if spans:
+                    out.append(spans)
+            return out
+
+        return Call.of(run)
 
     def get_traces_query(self, request: QueryRequest) -> Call[List[List[Span]]]:
-        return self._archive.get_traces_query(request)
+        if self._disk is None:
+            return self._archive.get_traces_query(request)
+
+        def run() -> List[List[Span]]:
+            if not self.search_enabled:
+                return []
+            return self._disk_query(request)
+
+        return Call.of(run)
+
+    def _disk_query(self, request: QueryRequest) -> List[List[Span]]:
+        """getTraces over the disk archive: vectorized candidate masks on
+        the INDEXED columns (service/span-name/remote/duration/window),
+        then decode candidate traces and apply the exact
+        ``QueryRequest.test`` predicate — annotationQuery and every other
+        non-indexed clause are exact by post-filtering, the reference's
+        fetch-then-filter row-store shape. Candidates scan newest
+        segments first; if the post-filter starves the limit the scan
+        widens once (the bounded-scan trade of a windowed store)."""
+        from zipkin_tpu.internal.span_node import merge_trace
+        from zipkin_tpu.model import json_v2
+        from zipkin_tpu.storage.spi import group_by_trace_id, trace_id_key
+
+        svc_id = rsvc_id = name_id = None
+        if request.service_name:
+            svc_id = self.vocab.services.get(request.service_name.lower())
+            if svc_id is None:
+                return []
+        if request.remote_service_name:
+            rsvc_id = self.vocab.services.get(
+                request.remote_service_name.lower()
+            )
+            if rsvc_id is None:
+                return []
+        if request.span_name:
+            name_id = self.vocab.span_names.get(request.span_name.lower())
+            if name_id is None:
+                return []
+        lo_min = epoch_minutes(request.end_ts - request.lookback)
+        hi_min = epoch_minutes(request.end_ts)
+
+        def fetch(cand_limit: int) -> Tuple[List[List[Span]], bool]:
+            # ONE view snapshot for the whole query: the live segment
+            # sorts its rows when a view is taken, so per-trace
+            # re-snapshots would re-sort per candidate
+            views = self._disk.views()
+            cands = self._disk.candidate_trace_ids(
+                ts_lo_min=lo_min, ts_hi_min=hi_min,
+                svc_id=svc_id, rsvc_id=rsvc_id, name_id=name_id,
+                min_dur=request.min_duration, max_dur=request.max_duration,
+                limit=cand_limit, views=views,
+            )
+            by_key: dict = {}
+            for id64, _ in cands:
+                raw = self._disk.fetch_trace_raw(
+                    id64 & 0xFFFFFFFF, id64 >> 32, 0, 0, strict=False,
+                    views=views,
+                )
+                spans = []
+                for r in raw:
+                    try:
+                        spans.append(json_v2.decode_one_span(r))
+                    except Exception:  # pragma: no cover
+                        continue
+                for group in group_by_trace_id(spans, self.strict_trace_id):
+                    key = trace_id_key(
+                        group[0].trace_id, self.strict_trace_id
+                    )
+                    by_key.setdefault(key, []).extend(group)
+            # union with the RAM archive (object-path spans of the same
+            # traces plus traces only it holds), then exact predicate
+            for trace in self._archive.get_traces_query(request).execute():
+                key = trace_id_key(trace[0].trace_id, self.strict_trace_id)
+                by_key.setdefault(key, []).extend(trace)
+            out = []
+            for spans in by_key.values():
+                merged = merge_trace(spans)
+                if request.test(merged):
+                    out.append(merged)
+            out.sort(
+                key=lambda t: max((s.timestamp or 0) for s in t),
+                reverse=True,
+            )
+            return out[: request.limit], len(cands) >= cand_limit
+
+        results, capped = fetch(request.limit * 4 + 16)
+        if capped and len(results) < request.limit:
+            # the post-filter starved the limit inside the first scan
+            # window: widen once before settling for fewer results
+            results, _ = fetch((request.limit * 4 + 16) * 8)
+        return results
 
     def get_service_names(self) -> Call[List[str]]:
-        return self._archive.get_service_names()
+        if self._disk is None:
+            return self._archive.get_service_names()
+
+        def run() -> List[str]:
+            if not self.search_enabled:
+                return []
+            # ids seen as a LOCAL service (remote names share the vocab
+            # table but must not list — upstream ServiceAndSpanNames
+            # semantics); bounded by max_services, listed without a
+            # retention cutoff
+            with self._names_lock:
+                ids = list(self._local_svc_ids)
+            names = {self.vocab.services.lookup(s) for s in ids}
+            return sorted(n for n in names if n)
+
+        return Call.of(run)
 
     def get_remote_service_names(self, service_name: str) -> Call[List[str]]:
-        return self._archive.get_remote_service_names(service_name)
+        if self._disk is None:
+            return self._archive.get_remote_service_names(service_name)
+
+        def run() -> List[str]:
+            if not self.search_enabled:
+                return []
+            sid = self.vocab.services.get(service_name.lower())
+            with self._names_lock:
+                rids = list(self._remote_by_svc.get(sid or -1, ()))
+            names = {self.vocab.services.lookup(r) for r in rids}
+            names |= set(
+                self._archive.get_remote_service_names(service_name).execute()
+            )
+            return sorted(n for n in names if n)
+
+        return Call.of(run)
 
     def get_span_names(self, service_name: str) -> Call[List[str]]:
-        return self._archive.get_span_names(service_name)
+        if self._disk is None:
+            return self._archive.get_span_names(service_name)
+
+        def run() -> List[str]:
+            if not self.search_enabled:
+                return []
+            sid = self.vocab.services.get(service_name.lower())
+            if sid is None:
+                return []
+            with self.vocab._lock:
+                pairs = list(self.vocab._key_list)
+            names = {
+                self.vocab.span_names.lookup(nid)
+                for s, nid in pairs
+                if s == sid
+            }
+            return sorted(n for n in names if n)
+
+        return Call.of(run)
 
     def get_keys(self) -> Call[List[str]]:
         return self._archive.get_keys()
@@ -520,6 +944,7 @@ class TpuStorage(
             "nativeVocabOverflow": (
                 self._nvocab.overflow if self._nvocab is not None else 0
             ),
+            **(self._disk.counters() if self._disk is not None else {}),
         }
 
     # -- lifecycle -------------------------------------------------------
@@ -533,6 +958,8 @@ class TpuStorage(
 
     def close(self) -> None:
         self._closed = True
+        if self._disk is not None:
+            self._disk.close()
         self._archive.close()
 
     def clear(self) -> None:
