@@ -45,6 +45,29 @@ def main() -> None:
                     "bytes": len(body),
                 }
             )
+    # the native columnar tier (the line-rate ingest floor): JSON v2 and
+    # proto3 parse+intern straight into device columns
+    from zipkin_tpu import native
+
+    if native.available():
+        from zipkin_tpu.model import json_v2, proto3
+        from zipkin_tpu.tpu.columnar import Vocab
+
+        spans = lots_of_spans(65_536, seed=7, services=40, span_names=120)
+        for fmt, body in (
+            ("JSON_V2", json_v2.encode_span_list(spans)),
+            ("PROTO3", proto3.encode_span_list(spans)),
+        ):
+            nv = native.NativeVocab(Vocab(1024, 8192))
+            rate = _bench(lambda: native.parse_spans(body, nvocab=nv))
+            out.append(
+                {
+                    "corpus": "spans64k",
+                    "format": f"native-{fmt}",
+                    "parse_intern_spans_per_sec": round(rate * len(spans)),
+                    "bytes": len(body),
+                }
+            )
     for row in out:
         print(json.dumps(row))
 

@@ -181,13 +181,15 @@ class Collector:
                 # this poison-pill semantic (SURVEY.md §3.3).
                 self.mp_ingester.submit(data)
                 return 0
-        if self.fast_ingest and (
-            encoding is None or encoding is codec.Encoding.JSON_V2
-        ):
+        # the native tier parses JSON v2 AND proto3 ListOfSpans (r4:
+        # gRPC/proto3 ingest was the one first-class hot codec still on
+        # the ~30k/s object path — VERDICT r3 order 6)
+        _FAST = (codec.Encoding.JSON_V2, codec.Encoding.PROTO3)
+        if self.fast_ingest and (encoding is None or encoding in _FAST):
             from zipkin_tpu.storage.throttle import RejectedExecutionError
 
             try:
-                if encoding is not None or codec.detect(data) is codec.Encoding.JSON_V2:
+                if encoding is not None or codec.detect(data) in _FAST:
                     result = self.storage.ingest_json_fast(data, self.sampler)
                     if result is not None:
                         accepted, sample_dropped = result

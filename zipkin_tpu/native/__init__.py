@@ -108,6 +108,12 @@ def _load():
         lib.zt_parse_spans_interned.argtypes = (
             base[:3] + [ctypes.c_void_p] + base[3:] + [i32p] * 4
         )
+        lib.zt_parse_proto3.restype = ctypes.c_long
+        lib.zt_parse_proto3.argtypes = base
+        lib.zt_parse_proto3_interned.restype = ctypes.c_long
+        lib.zt_parse_proto3_interned.argtypes = (
+            base[:3] + [ctypes.c_void_p] + base[3:] + [i32p] * 4
+        )
         lib.zt_vocab_new.restype = ctypes.c_void_p
         lib.zt_vocab_new.argtypes = [ctypes.c_uint32] * 3
         lib.zt_vocab_free.argtypes = [ctypes.c_void_p]
@@ -300,8 +306,11 @@ class NativeVocab:
 def parse_spans(
     data: bytes, cap: Optional[int] = None, nvocab: Optional[NativeVocab] = None
 ) -> Optional[ParsedColumns]:
-    """Parse a JSON v2 span array into columns; None => use the Python
-    codec (parse error, unsupported feature, or no native lib).
+    """Parse a JSON v2 span array OR a proto3 ``ListOfSpans`` into
+    columns; None => use the Python codec (parse error, unsupported
+    feature, or no native lib). Format is sniffed the same way the
+    object-path codec dispatcher does: '[' selects JSON, a 0x0A first
+    byte (ListOfSpans field-1 tag) selects proto3.
 
     With ``nvocab``, interning happens inside the parse (the ``*_id``
     columns are filled); the caller must hold the store's intern lock and
@@ -309,6 +318,25 @@ def parse_spans(
     """
     lib = _load()
     if lib is None:
+        return None
+    # Route by the SAME structural sniff the object-path dispatcher uses:
+    # 0x0A is ambiguous (proto3 field-1 tag AND a newline), and a naive
+    # first-byte test misroutes e.g. a ListOfSpans whose first span is
+    # 0x5B ('[') bytes long. codec.detect resolves it with a frame walk
+    # over the proto3 headers (O(#spans), no payload copy).
+    from zipkin_tpu.model import codec as _codec
+
+    try:
+        enc = _codec.detect(data)
+    except ValueError:
+        return None
+    if enc is _codec.Encoding.JSON_V2:
+        fn_plain, fn_interned = lib.zt_parse_spans, lib.zt_parse_spans_interned
+    elif enc is _codec.Encoding.PROTO3:
+        fn_plain, fn_interned = (
+            lib.zt_parse_proto3, lib.zt_parse_proto3_interned
+        )
+    else:
         return None
     if cap is None:
         # every span object contributes >= ~20 bytes; this bound never
@@ -350,14 +378,14 @@ def parse_spans(
         out.rsvc_id = np.zeros(cap, np.int32)
         out.name_id = np.zeros(cap, np.int32)
         out.key_id = np.zeros(cap, np.int32)
-        n = lib.zt_parse_spans_interned(
+        n = fn_interned(
             data, len(data), cap, nvocab.handle, *common,
             pi32(out.svc_id), pi32(out.rsvc_id),
             pi32(out.name_id), pi32(out.key_id),
         )
     else:
         out.svc_id = None
-        n = lib.zt_parse_spans(data, len(data), cap, *common)
+        n = fn_plain(data, len(data), cap, *common)
     if n < 0:
         return None
     out.n = int(n)

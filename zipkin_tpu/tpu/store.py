@@ -52,6 +52,19 @@ logger = logging.getLogger(__name__)
 from zipkin_tpu.native import PARSED_FIELDS as _PARSED_FIELDS
 
 
+def _decode_raw_span(raw: bytes):
+    """Decode one archived raw span slice: JSON objects start '{', a
+    proto3 Span message starts with a field tag byte — the archive holds
+    whichever wire format ingested the span."""
+    if raw[:1] == b"{":
+        from zipkin_tpu.model import json_v2
+
+        return json_v2.decode_one_span(raw)
+    from zipkin_tpu.model import proto3
+
+    return proto3.decode_span(raw)
+
+
 class TpuStorage(
     StorageComponent, SpanConsumer, SpanStore, ServiceAndSpanNames, AutocompleteTags
 ):
@@ -338,9 +351,10 @@ class TpuStorage(
         self._persist_archive_vocab()
 
     def ingest_json_fast(self, data: bytes, sampler=None):
-        """Line-rate ingest: raw JSON v2 bytes -> device aggregates via the
-        native columnar parser, skipping Span objects for the bulk of the
-        stream. A trace-affine 1/N sample IS archived at full fidelity
+        """Line-rate ingest: raw JSON v2 OR proto3 ``ListOfSpans`` bytes
+        -> device aggregates via the native columnar parser (format
+        sniffed by first byte), skipping Span objects for the bulk of
+        the stream. A trace-affine 1/N sample IS archived at full fidelity
         (the parser records each span's byte extent; sampled slices are
         re-decoded by the reference codec), so ``/api/v2/trace/{id}`` and
         search stay alive in fast mode — the round-1 gap where the
@@ -504,7 +518,6 @@ class TpuStorage(
         every = self._fast_archive_every
         if every <= 0:
             return
-        from zipkin_tpu.model import json_v2
         from zipkin_tpu.tpu.columnar import _mix32
 
         tid = (
@@ -518,8 +531,10 @@ class TpuStorage(
         spans = []
         for i in pick:
             try:
+                # format-aware: fast-path slices are JSON objects or
+                # proto3 Span messages, whichever wire ingested them
                 spans.append(
-                    json_v2.decode_one_span(data[off[i] : off[i] + ln[i]])
+                    _decode_raw_span(bytes(data[off[i] : off[i] + ln[i]]))
                 )
             except Exception:  # a slice the strict codec rejects: skip
                 continue
@@ -545,7 +560,7 @@ class TpuStorage(
         spans = []
         for raw in slices:
             try:
-                s = json_v2.decode_one_span(raw)
+                s = _decode_raw_span(raw)
             except Exception:  # pragma: no cover - parser accepted it
                 continue
             if self.strict_trace_id and normalize_trace_id(
@@ -650,7 +665,7 @@ class TpuStorage(
                 spans = []
                 for r in raw:
                     try:
-                        spans.append(json_v2.decode_one_span(r))
+                        spans.append(_decode_raw_span(r))
                     except Exception:  # pragma: no cover
                         continue
                 for group in group_by_trace_id(spans, self.strict_trace_id):
