@@ -1,0 +1,123 @@
+"""Host feed budget for an 8-chip mesh (VERDICT r3 order 4, 1-core box).
+
+The north-star claim (1M spans/s aggregate on a v5e-8) multiplies the
+single-chip measurement by 8 — but nothing had measured whether ONE host
+can FEED 8 devices at >=125k spans/s each. This harness prices every
+host-side stage of the sync fast path at the production batch size
+against an 8-shard mesh, then reports the end-to-end feed rate the host
+sustains and WHICH stage caps it.
+
+Stages (per 64k-span batch, JSON v2 and proto3):
+  parse+intern  native C parse into ParsedColumns (GIL-free C loop)
+  pack          pack_parsed -> SpanColumns (numpy, vectorized)
+  fuse+route    fuse_columns + radix shard routing -> [8, 11, per] wire
+  dispatch      device_put + jit step dispatch (async; on a real v5e
+                this overlaps device compute, so the HOST budget is the
+                sum of the stages above plus the non-overlapped part)
+
+Run on the CPU mesh (the relay's one real chip cannot host 8 shards):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python -m benchmarks.feed_budget
+The CPU-mesh step itself is NOT the number that matters (a CPU "device"
+is slow); the host stages are, because they are identical code whatever
+the backend. The report separates them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import numpy as np
+
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu import native
+    from zipkin_tpu.model import json_v2, proto3
+    from zipkin_tpu.parallel.mesh import make_mesh
+    from zipkin_tpu.parallel.sharded import ShardedAggregator
+    from zipkin_tpu.tpu.columnar import Vocab, pack_parsed, route_fused
+    from zipkin_tpu.tpu.state import AggConfig
+
+    assert native.available(), "feed budget needs the native tier"
+    batch = 65_536
+    n_shards = int(os.environ.get("FEED_SHARDS", 8))
+    reps = int(os.environ.get("FEED_REPS", 8))
+    spans = lots_of_spans(batch, seed=7, services=40, span_names=120)
+    payloads = {
+        "json_v2": json_v2.encode_span_list(spans),
+        "proto3": proto3.encode_span_list(spans),
+    }
+
+    def rate(fn, reps=reps):
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return batch * reps / (time.perf_counter() - t0)
+
+    out = {"artifact": "feed_budget", "batch": batch, "shards": n_shards,
+           "stages_spans_per_sec": {}}
+    stage = out["stages_spans_per_sec"]
+
+    parsed_by_fmt = {}
+    for fmt, data in payloads.items():
+        nv = native.NativeVocab(Vocab(1024, 8192))
+        stage[f"parse_intern_{fmt}"] = round(
+            rate(lambda: native.parse_spans(data, nvocab=nv))
+        )
+        parsed_by_fmt[fmt] = native.parse_spans(data, nvocab=nv)
+
+    vocab = Vocab(1024, 8192)
+    nv = native.NativeVocab(vocab)
+    parsed = native.parse_spans(payloads["json_v2"], nvocab=nv)
+    nv.sync()
+    stage["pack"] = round(rate(lambda: pack_parsed(parsed, vocab, batch)))
+    cols = pack_parsed(parsed, vocab, batch)
+    stage["fuse_route"] = round(rate(lambda: route_fused(cols, n_shards)))
+
+    # host-side feed loop against the mesh: parse->pack->route->dispatch
+    # with the device working asynchronously (block only at the end)
+    cfg = AggConfig()
+    agg = ShardedAggregator(cfg, make_mesh(n_shards))
+    agg.ingest(cols)  # compile
+    agg.block_until_ready()
+
+    def one_feed():
+        p = native.parse_spans(payloads["json_v2"], nvocab=nv)
+        c = pack_parsed(p, vocab, batch)
+        agg.ingest(c)
+
+    one_feed()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        one_feed()
+    agg.block_until_ready()
+    wall = time.perf_counter() - t0
+    out["feed_loop_spans_per_sec_with_cpu_mesh_step"] = round(
+        batch * reps / wall
+    )
+
+    # the host budget that transfers to a REAL v5e-8 (device step
+    # overlaps): sum of host stage costs per span
+    per_span_us = sum(
+        1e6 / stage[k] for k in ("parse_intern_json_v2", "pack", "fuse_route")
+    )
+    out["host_budget_spans_per_sec_json"] = round(1e6 / per_span_us)
+    per_span_us_p3 = sum(
+        1e6 / stage[k] for k in ("parse_intern_proto3", "pack", "fuse_route")
+    )
+    out["host_budget_spans_per_sec_proto3"] = round(1e6 / per_span_us_p3)
+    out["cores"] = os.cpu_count()
+    caps = min(
+        ("parse_intern_json_v2", "pack", "fuse_route"),
+        key=lambda k: stage[k],
+    )
+    out["capping_stage"] = caps
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
