@@ -29,6 +29,22 @@ import json
 import os
 import time
 
+# the axon sitecustomize force-sets JAX_PLATFORMS=axon at interpreter
+# start (after the shell env), so hard-override in-process like
+# tests/conftest.py does — the 8-shard mesh needs CPU virtual devices
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags
+        + " --xla_force_host_platform_device_count="
+        + os.environ.get("FEED_SHARDS", "8")
+    ).strip()
+
+import jax  # noqa: E402  (before any zipkin import touches jax)
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def main() -> None:
     import numpy as np

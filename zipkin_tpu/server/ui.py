@@ -36,9 +36,21 @@ PAGE = """<!doctype html>
 <header><h1>zipkin-tpu</h1><span id="info" class="muted"></span></header>
 <main>
 <section><h2>Find traces</h2>
- <select id="svc"><option value="">all services</option></select>
+ <select id="svc" onchange="loadNames()"><option value="">all services</option></select>
+ <select id="spanname"><option value="">all spans</option></select>
+ <input id="annq" placeholder="annotationQuery: error and http.method=GET" style="width:22em">
+ <input id="mindur" type="number" placeholder="min µs" style="width:6em">
+ <input id="maxdur" type="number" placeholder="max µs" style="width:6em">
+ <select id="lookback">
+  <option value="3600000">last hour</option>
+  <option value="86400000">last day</option>
+  <option value="604800000" selected>last 7 days</option>
+ </select>
  <input id="limit" type="number" value="10" style="width:4em">
  <button onclick="findTraces()">search</button>
+ <span style="margin-left:12px">trace id:
+  <input id="tid" placeholder="hex trace id" style="width:18em">
+  <button onclick="gotoTrace()">open</button></span>
  <div id="traces"></div>
  <div id="detail"></div>
 </section>
@@ -64,19 +76,51 @@ async function boot(){
   try{const s=await get('/api/v2/services');
     for(const n of s){const o=document.createElement('option');o.value=o.textContent=n;$('#svc').append(o)}}catch(e){}
 }
+async function loadNames(){
+  // per-service span names for the spanName filter (the Lens discover
+  // page's second dropdown)
+  const svc=$('#svc').value, sel=$('#spanname');
+  sel.innerHTML='<option value="">all spans</option>';
+  if(!svc)return;
+  try{const names=await get('/api/v2/spans?serviceName='+encodeURIComponent(svc));
+    for(const n of names){const o=document.createElement('option');o.value=o.textContent=n;sel.append(o)}
+  }catch(e){}
+}
+function gotoTrace(){
+  const raw=$('#tid').value.trim().toLowerCase();
+  const id=hexOnly(raw);
+  const el=$('#detail');
+  if(!id){el.innerHTML='<p class="err">not a hex trace id</p>';return}
+  detail(id).catch(e=>{el.innerHTML='<p class="err">trace not found: '+esc(id)+'</p>'});
+}
 async function findTraces(){
   const svc=$('#svc').value, lim=$('#limit').value||10;
-  const q=new URLSearchParams({endTs:Date.now(),lookback:7*864e5,limit:lim});
+  const elq=$('#traces');
+  const q=new URLSearchParams({endTs:Date.now(),
+    lookback:$('#lookback').value||7*864e5,limit:lim});
   if(svc)q.set('serviceName',svc);
-  const traces=await get('/api/v2/traces?'+q);
-  const el=$('#traces');el.innerHTML='';
+  const name=$('#spanname').value; if(name)q.set('spanName',name);
+  const annq=$('#annq').value.trim(); if(annq)q.set('annotationQuery',annq);
+  const mind=$('#mindur').value; if(mind)q.set('minDuration',mind);
+  const maxd=$('#maxdur').value; if(maxd)q.set('maxDuration',maxd);
+  let traces;
+  try{traces=await get('/api/v2/traces?'+q)}
+  catch(e){elq.innerHTML='<p class="err">search failed: '+esc(e.message)+
+    ' (check the filter values)</p>';return}
+  const el=elq;el.innerHTML='';
+  if(!traces.length){el.innerHTML='<p class="muted">no traces matched</p>';return}
   const t=document.createElement('table');
-  t.innerHTML='<tr><th>trace</th><th>spans</th><th>duration µs</th><th></th></tr>';
+  t.innerHTML='<tr><th>start</th><th>trace</th><th>services</th><th>spans</th><th>duration µs</th><th></th></tr>';
   for(const tr of traces){
     const root=tr.reduce((a,b)=>(a.timestamp||1e18)<(b.timestamp||1e18)?a:b);
     const id=hexOnly(root.traceId);
+    const svcs=[...new Set(tr.map(s=>(s.localEndpoint||{}).serviceName).filter(Boolean))];
+    const when=root.timestamp?new Date(root.timestamp/1000).toISOString().slice(0,19):'';
+    const anyErr=tr.some(s=>s.tags&&s.tags.error!==undefined);
     const row=document.createElement('tr');
-    row.innerHTML=`<td>${esc(id)}</td><td>${tr.length}</td><td>${esc(root.duration||'')}</td>
+    row.innerHTML=`<td>${esc(when)}</td><td class="${anyErr?'err':''}">${esc(id)}</td>
+      <td>${esc(svcs.slice(0,4).join(', '))}${svcs.length>4?' …':''}</td>
+      <td>${tr.length}</td><td>${esc(root.duration||'')}</td>
       <td><button onclick="detail('${id}')">view</button></td>`;
     t.append(row);
   }
