@@ -360,3 +360,104 @@ class TestFullDurabilityPlane:
                 expect, key=lambda s: s.id
             ), probe.trace_id
         store2.close()
+
+
+class TestAdvisorFixesR4:
+    def test_live_path_resolves_after_seal_and_retention(self, tmp_path):
+        """A views() snapshot that captured the LIVE segment (a path
+        string) must keep reading after the segment seals — and even
+        after retention unlinks it — via the sealed segment's retained
+        fd (ADVICE r4: previously a FileNotFoundError silently returned
+        no spans)."""
+        arc = SpanArchive(
+            str(tmp_path / "a"), max_bytes=1 << 30, segment_bytes=1 << 20
+        )
+        n = 32
+        payload = b"z" * (n * 10)
+        base = dict(
+            span_off=np.arange(n, dtype=np.uint32) * 10,
+            span_len=np.full(n, 10, np.uint32),
+            tl1=np.zeros(n, np.uint32), th0=np.zeros(n, np.uint32),
+            th1=np.zeros(n, np.uint32),
+            svc=np.ones(n, np.uint32), rsvc=np.zeros(n, np.uint32),
+            name=np.ones(n, np.uint32), key=np.ones(n, np.uint32),
+            ts_min=np.full(n, 5, np.uint32),
+            dur=np.ones(n, np.uint64), err=np.zeros(n, bool),
+        )
+        arc.append_batch(payload=payload, tl0=np.full(n, 3, np.uint32), **base)
+        views = arc.views()
+        assert isinstance(views[0][2], str)  # live segment = path string
+        live_path = views[0][2]
+        arc.flush()  # seals the live segment
+        # retention unlinks it while the snapshot is still held
+        arc.max_bytes = 1
+        arc.append_batch(payload=payload, tl0=np.full(n, 4, np.uint32), **base)
+        arc.flush()
+        assert not os.path.exists(live_path)
+        raw = arc.fetch_trace_raw(3, 0, 0, 0, strict=False, views=views)
+        assert len(raw) == n and raw[0] == b"z" * 10
+        arc.close()
+
+    def test_service_capacity_guard(self, tmp_path):
+        """Service-id capacity beyond the archive's 16-bit id lanes must
+        fail loudly, not truncate (ADVICE r4). AggConfig itself rejects
+        capacities past the packed-wire 16-bit limit — the same bound the
+        archive index shares — so the truncating config is
+        unconstructable; this pins that guard so a future wire-format
+        widening cannot silently outgrow the archive lanes."""
+        with pytest.raises(ValueError, match="65536"):
+            AggConfig(max_services=1 << 17)
+        from zipkin_tpu.tpu.columnar import MAX_WIRE_SERVICES
+
+        assert MAX_WIRE_SERVICES <= 1 << 16  # archive svc/rsvc lane width
+
+    @pytest.mark.skipif(not native.available(), reason="native codec")
+    def test_autocomplete_fed_with_disk_archive_on(self, tmp_path):
+        """With the disk archive enabled, fast-path ingest must still
+        feed the RAM sample when autocomplete keys are configured —
+        autocompleteTags serves from the RAM archive only (ADVICE r4)."""
+        from zipkin_tpu.model.span import Span
+        from zipkin_tpu.parallel.mesh import make_mesh
+
+        store = TpuStorage(
+            config=SMALL, mesh=make_mesh(1), pad_to_multiple=256,
+            fast_archive_sample=1, archive_dir=str(tmp_path / "arc"),
+            autocomplete_keys=("env",),
+        )
+        from zipkin_tpu.model.span import Endpoint
+
+        ep = Endpoint.create("svc", "127.0.0.1")
+        spans = [
+            Span(
+                trace_id=f"{i + 1:032x}", id=f"{i + 1:016x}",
+                name="get", local_endpoint=ep,
+                timestamp=1_700_000_000_000_000 + i, duration=1000,
+                tags={"env": "prod"},
+            )
+            for i in range(8)
+        ]
+        store.ingest_json_fast(encode_span_list(spans))
+        assert store.get_keys().execute() == ["env"]
+        assert store.get_values("env").execute() == ["prod"]
+        store.close()
+
+
+class TestArchiveDefaultPosture:
+    def test_fast_mode_defaults_archive_on(self, monkeypatch):
+        """r5 default decision: fast ingest without TPU_ARCHIVE_DIR gets
+        a budget-bounded disk archive (reference keeps every span
+        queryable by default); "off" disables explicitly."""
+        from zipkin_tpu.server.config import ServerConfig
+
+        monkeypatch.setenv("TPU_FAST_INGEST", "true")
+        monkeypatch.delenv("TPU_ARCHIVE_DIR", raising=False)
+        got = ServerConfig.from_env().tpu_archive_dir
+        assert got.endswith("zipkin-tpu-archive") and os.path.isabs(got)
+        monkeypatch.setenv("TPU_ARCHIVE_DIR", "off")
+        assert ServerConfig.from_env().tpu_archive_dir is None
+        monkeypatch.setenv("TPU_ARCHIVE_DIR", "/data/arc")
+        assert ServerConfig.from_env().tpu_archive_dir == "/data/arc"
+        # object-path default posture unchanged (bounded RAM store)
+        monkeypatch.setenv("TPU_FAST_INGEST", "false")
+        monkeypatch.delenv("TPU_ARCHIVE_DIR", raising=False)
+        assert ServerConfig.from_env().tpu_archive_dir is None

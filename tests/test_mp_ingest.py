@@ -304,3 +304,66 @@ def test_sampler_parity():
         ing.close()
     assert sync.agg.host_counters == mp_store.agg.host_counters
     assert ing.counters["sampleDropped"] > 0
+
+
+def test_mp_tier_feeds_disk_archive(tmp_path):
+    """VERDICT r4 order 2: the scale-out ingest tier must not downgrade
+    the trace store — with the disk archive enabled, traces ingested
+    through MP workers must be COMPLETELY readable from the archive
+    (worker-built raw records, dispatcher-remapped ids), byte-equal to
+    what the sync fast path would have stored."""
+    from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
+
+    ps = payloads(n_payloads=4, spans_each=1024)
+
+    mp_store = TpuStorage(
+        config=CFG, mesh=make_mesh(2), pad_to_multiple=256,
+        archive_max_span_count=100_000,
+        archive_dir=str(tmp_path / "mp_arc"), fast_archive_sample=0,
+    )
+    ing = MultiProcessIngester(mp_store, workers=2, queue_depth=8)
+    try:
+        for p in ps:
+            ing.submit(p)
+        ing.drain()
+    finally:
+        ing.close()
+
+    sync_store = TpuStorage(
+        config=CFG, mesh=make_mesh(2), pad_to_multiple=256,
+        archive_max_span_count=100_000,
+        archive_dir=str(tmp_path / "sync_arc"), fast_archive_sample=0,
+    )
+    ingest_sync(sync_store, ps)
+
+    # every acked trace id reads back complete from the MP store's
+    # archive, identical to the sync store's answer
+    from zipkin_tpu.model import json_v2
+
+    checked = 0
+    for p in ps[:2]:
+        for s in json_v2.decode_span_list(p)[:64]:
+            got = sorted(
+                json_v2.encode_span(x)
+                for x in mp_store.get_trace(s.trace_id).execute()
+            )
+            want = sorted(
+                json_v2.encode_span(x)
+                for x in sync_store.get_trace(s.trace_id).execute()
+            )
+            assert got == want and got, s.trace_id
+            checked += 1
+    assert checked > 50
+    # search parity over the archive index (service-indexed candidates)
+    from zipkin_tpu.storage.spi import QueryRequest
+
+    svc = json_v2.decode_span_list(ps[0])[0].local_service_name
+    req = QueryRequest(
+        service_name=svc, end_ts=2_000_000_000_000, lookback=2_000_000_000_000,
+        limit=10,
+    )
+    got = mp_store.get_traces_query(req).execute()
+    want = sync_store.get_traces_query(req).execute()
+    assert len(got) == len(want) > 0
+    mp_store.close()
+    sync_store.close()

@@ -65,20 +65,38 @@ def build_storage(config: ServerConfig) -> StorageComponent:
         from zipkin_tpu.storage.tpu import TpuStorage
         from zipkin_tpu.tpu.state import AggConfig
 
-        return TpuStorage(
-            max_span_count=config.mem_max_spans,
-            batch_size=config.tpu_batch_size,
-            num_devices=config.tpu_devices,
-            checkpoint_dir=config.tpu_checkpoint_dir,
-            wal_dir=config.tpu_wal_dir,
-            wal_fsync=config.tpu_wal_fsync,
-            archive_dir=config.tpu_archive_dir,
-            archive_max_bytes=config.tpu_archive_max_bytes,
-            archive_segment_bytes=config.tpu_archive_segment_bytes,
-            config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
-            fast_archive_sample=config.tpu_fast_archive_sample,
-            **common,
-        )
+        def _make(archive_dir):
+            return TpuStorage(
+                max_span_count=config.mem_max_spans,
+                batch_size=config.tpu_batch_size,
+                num_devices=config.tpu_devices,
+                checkpoint_dir=config.tpu_checkpoint_dir,
+                wal_dir=config.tpu_wal_dir,
+                wal_fsync=config.tpu_wal_fsync,
+                archive_dir=archive_dir,
+                archive_max_bytes=config.tpu_archive_max_bytes,
+                archive_segment_bytes=config.tpu_archive_segment_bytes,
+                config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
+                fast_archive_sample=config.tpu_fast_archive_sample,
+                **common,
+            )
+
+        if config.tpu_archive_dir:
+            logger.info(
+                "span archive: %s (budget %d bytes)",
+                config.tpu_archive_dir, config.tpu_archive_max_bytes,
+            )
+            try:
+                return _make(config.tpu_archive_dir)
+            except OSError as e:
+                # the default-on archive must not brick a server whose
+                # cwd is read-only: degrade to archive-free (the r3
+                # posture) loudly instead of refusing to boot
+                logger.warning(
+                    "span archive dir %s unusable (%s); serving without "
+                    "the disk archive", config.tpu_archive_dir, e,
+                )
+        return _make(None)
     raise ValueError(f"unknown STORAGE_TYPE: {config.storage_type}")
 
 

@@ -63,6 +63,56 @@ def _id64(tl0: np.ndarray, tl1: np.ndarray) -> np.ndarray:
     return (tl1.astype(np.uint64) << np.uint64(32)) | tl0.astype(np.uint64)
 
 
+def parsed_record(parsed) -> Optional[tuple]:
+    """Build one ``append_batch`` argument tuple from a native-parser
+    chunk (``ParsedColumns``): compacted payload + per-span columns.
+    Numpy-only so MP-tier parse workers (which must not import jax) can
+    build records worker-side; service/name/key lanes carry whatever id
+    space the parser interned into (the MP dispatcher remaps them
+    worker-local -> global before appending). Returns None for an empty
+    chunk.
+
+    The payload is the chunk's contiguous byte range unless sampling
+    punched >5% holes in it — then it compacts to exactly the kept
+    slices, so dropped spans' raw bytes are never persisted as
+    unindexed garbage."""
+    n = parsed.n
+    if n == 0:
+        return None
+    off = parsed.span_off[:n].astype(np.uint64)
+    ln = parsed.span_len[:n].astype(np.uint64)
+    lo = int(off[0])
+    hi = int((off + ln).max())
+    span_bytes = int(ln.sum())
+    if span_bytes < (hi - lo) * 95 // 100:
+        data = parsed.data
+        parts = [
+            bytes(data[int(o) : int(o) + int(l)])
+            for o, l in zip(off.tolist(), ln.tolist())
+        ]
+        payload = b"".join(parts)
+        new_off = np.concatenate([[0], np.cumsum(ln[:-1])]).astype(np.uint32)
+    else:
+        payload = bytes(parsed.data[lo:hi])
+        new_off = (off - lo).astype(np.uint32)
+    return (
+        payload,
+        new_off,
+        parsed.span_len[:n].copy(),
+        parsed.tl0[:n].copy(),
+        parsed.tl1[:n].copy(),
+        parsed.th0[:n].copy(),
+        parsed.th1[:n].copy(),
+        parsed.svc_id[:n].copy(),
+        parsed.rsvc_id[:n].copy(),
+        parsed.name_id[:n].copy(),
+        parsed.key_id[:n].copy(),
+        (parsed.ts_us[:n] // 60_000_000).astype(np.uint32),
+        np.where(parsed.has_dur[:n], parsed.dur_us[:n], 0).astype(np.uint64),
+        parsed.err[:n].copy(),
+    )
+
+
 class _Segment:
     """One sealed segment: data file + mmap'd sorted index sidecars."""
 
@@ -132,6 +182,16 @@ class SpanArchive:
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         self._sealed: List[_Segment] = []  # oldest -> newest
+        # path -> _Segment for every sealed segment: a views() snapshot
+        # taken while a segment was LIVE holds its path string; if the
+        # segment seals (and maybe gets retention-unlinked) while the
+        # query still holds that snapshot, the path resolves here to the
+        # sealed segment's retained fd instead of FileNotFoundError ->
+        # silent [] (ADVICE r4). Retention moves its entry to a small
+        # FIFO (`_retired`) so reads survive a bounded churn window
+        # without pinning every evicted segment's fd forever.
+        self._path_to_seg: Dict[str, _Segment] = {}
+        self._retired: List[str] = []  # paths, oldest first, cap 8
         self._live_fh = None
         self._live_path: Optional[str] = None
         self._live_bytes = 0
@@ -229,7 +289,9 @@ class SpanArchive:
         order = np.argsort(ids, kind="stable")
         np.save(self._live_path + ".ids.npy", ids[order])
         np.save(self._live_path + ".cols.npy", rows[order])
-        self._sealed.append(_Segment(self._live_path))
+        seg = _Segment(self._live_path)
+        self._sealed.append(seg)
+        self._path_to_seg[self._live_path] = seg
         self._live_path = None
         self._live_bytes = 0
 
@@ -247,6 +309,17 @@ class SpanArchive:
                     os.remove(old.path + suffix)
                 except OSError:
                     pass
+            # keep the path resolvable (retained fd) for a bounded churn
+            # window; beyond it the oldest retired entry's segment drops
+            # its map reference and GC closes the fd. Cap 2: unlinked-
+            # but-open segments still pin disk space invisible to the
+            # byte budget, so the pinned overhang is bounded to ~2
+            # segments and freed by the next retirements (or close())
+            self._retired.append(old.path)
+            while len(self._retired) > 2:
+                gone = self._path_to_seg.pop(self._retired.pop(0), None)
+                if gone is not None and gone not in self._sealed:
+                    gone.close()
 
     def flush(self) -> None:
         """Seal the live segment so its spans are index-served (tests,
@@ -265,6 +338,12 @@ class SpanArchive:
             self._closed = True
             for s in self._sealed:
                 s.close()
+            # retired segments hold unlinked fds/mmaps past retention —
+            # release them too or close() leaks the pinned disk space
+            for s in self._path_to_seg.values():
+                s.close()
+            self._path_to_seg.clear()
+            self._retired.clear()
 
     # -- recovery --------------------------------------------------------
 
@@ -280,7 +359,9 @@ class SpanArchive:
             )
             if os.path.exists(path + ".ids.npy"):
                 try:
-                    self._sealed.append(_Segment(path))
+                    seg = _Segment(path)
+                    self._sealed.append(seg)
+                    self._path_to_seg[path] = seg
                     continue
                 except Exception:
                     logger.warning("archive: bad sidecars for %s", path)
@@ -360,13 +441,22 @@ class SpanArchive:
             return [
                 src.pread(int(off), int(ln)) for off, ln in rows[:, 4:6]
             ]
+        # live-segment path string: the segment may have SEALED (and even
+        # been retention-unlinked) since the snapshot was taken — resolve
+        # through the sealed segment's retained fd when it has
+        with self._lock:
+            seg = self._path_to_seg.get(src)
+        if seg is not None:
+            return [
+                seg.pread(int(off), int(ln)) for off, ln in rows[:, 4:6]
+            ]
         out = []
         try:
             with open(src, "rb") as fh:
                 for off, ln in rows[:, 4:6]:
                     fh.seek(int(off))
                     out.append(fh.read(int(ln)))
-        except FileNotFoundError:  # pragma: no cover - live never deleted
+        except FileNotFoundError:  # pragma: no cover - bounded-churn miss
             return []
         return out
 

@@ -81,6 +81,7 @@ def _worker_main(
 
     from zipkin_tpu import native
     from zipkin_tpu.native import PARSED_FIELDS
+    from zipkin_tpu.tpu.archive import parsed_record
     from zipkin_tpu.tpu.columnar import Vocab, pack_parsed, route_fused
 
     shm = shared_memory.SharedMemory(name=shm_name)
@@ -90,6 +91,8 @@ def _worker_main(
     max_batch = params["max_batch"]
     pad = params["pad"]
     every = params["archive_every"]
+    disk = params["archive_disk"]  # ship per-chunk raw records for the
+    # disk archive (worker-LOCAL vocab ids; dispatcher remaps to global)
     boundary = params["sample_boundary"]  # None = keep everything
     # journal cursors: how much of the local vocab has been reported
     sent_svc, sent_name, sent_pair = 1, 1, 1
@@ -125,7 +128,7 @@ def _worker_main(
             state["completed"] = True
             result_q.put(
                 (_KIND_BATCH, widx, None, None, 0, 0, 0, dropped,
-                 [], [], [], [], (0, 0))
+                 [], [], [], [], (0, 0), None)
             )
             return
         for lo in range(0, n, max_batch):
@@ -142,6 +145,7 @@ def _worker_main(
             cols = pack_parsed(sub, vocab, pad)
             fused = route_fused(cols, n_shards)
             arch = _extract_archive_slices(sub, every)
+            rec = parsed_record(sub) if disk else None
             # vocab journal since the last report (id order)
             svc_new = vocab.services._names[sent_svc:]
             name_new = vocab.span_names._names[sent_name:]
@@ -179,7 +183,7 @@ def _worker_main(
                     int((cols.valid & cols.has_dur).sum()),
                     int((cols.valid & cols.err).sum()),
                     dropped if is_last else -1,
-                    svc_new, name_new, pairs_new, arch, ts_range,
+                    svc_new, name_new, pairs_new, arch, ts_range, rec,
                 )
             )
 
@@ -209,7 +213,7 @@ def _worker_main(
                         # instead — logged above, bounded to one payload.
                         result_q.put(
                             (_KIND_BATCH, widx, None, None, 0, 0, 0, 0,
-                             [], [], [], [], (0, 0))
+                             [], [], [], [], (0, 0), None)
                         )
     finally:
         result_q.put((_KIND_EOF, widx))
@@ -254,15 +258,6 @@ class MultiProcessIngester:
 
         if not native.available():
             raise RuntimeError("native codec unavailable; MP tier needs it")
-        if getattr(store, "_disk", None) is not None:
-            # workers ship only the packed wire + sampled slices; the
-            # raw payload never reaches the dispatcher, so the disk
-            # archive cannot cover MP-ingested spans
-            logger.warning(
-                "MP ingest tier does not feed the disk span archive; "
-                "traces ingested here are not raw-archived (use the "
-                "sync fast path for archive-complete ingest)"
-            )
         self.store = store
         self.workers = workers
         self._sampler = sampler
@@ -282,13 +277,25 @@ class MultiProcessIngester:
         self._work_q = ctx.Queue(maxsize=queue_depth or 2 * workers)
         self._result_q = ctx.Queue()
         self._sems = [ctx.Semaphore(slots_per_worker) for _ in range(workers)]
+        has_disk = getattr(store, "_disk", None) is not None
         params = dict(
             max_services=store.vocab.services.capacity,
             max_keys=store.vocab.max_keys,
             n_shards=agg.n_shards,
             max_batch=store.max_batch,
             pad=store._pad,
-            archive_every=store._fast_archive_every,
+            # workers build per-chunk raw-archive records (payload +
+            # index columns, worker-local ids) that the dispatcher
+            # remaps and appends — the MP tier and the complete trace
+            # store are no longer mutually exclusive (VERDICT r4 order
+            # 2). The RAM 1/N sample then only matters for
+            # autocompleteTags, exactly like the sync fast path.
+            archive_disk=has_disk,
+            archive_every=(
+                store._fast_archive_every
+                if (not has_disk or store.autocomplete_keys)
+                else 0
+            ),
             sample_boundary=(
                 sampler._boundary
                 if sampler is not None and sampler.rate < 1.0
@@ -316,6 +323,13 @@ class MultiProcessIngester:
         self._cv = threading.Condition()
         self._closed = False
         self._dispatch_error: Optional[BaseException] = None
+        # reap reentrancy guard: _reap_dead_workers drains result_q via
+        # _handle_msg, which can discover ANOTHER premature EOF — a
+        # recursive reap would abort the outer one before its work-queue
+        # salvage ran (ADVICE r4). Extra dead workers found mid-reap are
+        # collected here and folded into the current reap instead.
+        self._reaping = False
+        self._reap_extra: List[int] = []
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="mp-ingest-dispatch", daemon=True
         )
@@ -454,7 +468,11 @@ class MultiProcessIngester:
         applied, payloads still in the work queue re-dispatch on the
         slow path, but the payload it was processing is unaccountable
         (its chunk count is unknown), so drain() must raise rather than
-        guess."""
+        guess. Runs at most once per dispatcher lifetime (it ends in
+        raise); further dead workers discovered while draining results
+        below are folded into THIS reap via _reap_extra, never a nested
+        reap that would abort the salvage pass (ADVICE r4)."""
+        self._reaping = True
         # timeout-based drains, not get_nowait(): mp.Queue puts go
         # through a feeder thread, so a just-submitted payload can be
         # in the pipe but not yet visible — get_nowait() would miss it
@@ -465,6 +483,9 @@ class MultiProcessIngester:
             except queue.Empty:
                 break
             self._handle_msg(msg, maps, eof_set)
+        if self._reap_extra:
+            dead = dead + [w for w in self._reap_extra if w not in dead]
+            self._reap_extra = []
         salvaged = 0
         # stop salvaging the moment close() starts: its shutdown
         # sentinels must reach the surviving workers, not this loop
@@ -515,7 +536,13 @@ class MultiProcessIngester:
                 # work_q.get) with its inflight payloads unaccounted —
                 # without this, drain() would wedge with no error and
                 # the liveness check would skip it (it IS in eof_set)
-                self._reap_dead_workers([msg[1]], maps, eof_set)
+                if self._reaping:
+                    # already inside a reap's result drain: fold this
+                    # worker into the current reap instead of recursing
+                    # (a nested reap would abort the outer salvage pass)
+                    self._reap_extra.append(msg[1])
+                else:
+                    self._reap_dead_workers([msg[1]], maps, eof_set)
             return
         if kind == _KIND_FALLBACK:
             _, widx, payload = msg
@@ -525,7 +552,7 @@ class MultiProcessIngester:
             return
         (
             _, widx, slot, shape, n_spans, n_dur, n_err, dropped,
-            svc_new, name_new, pairs_new, arch, ts_range,
+            svc_new, name_new, pairs_new, arch, ts_range, rec,
         ) = msg
         m = maps[widx]
         if svc_new or name_new or pairs_new:
@@ -555,6 +582,18 @@ class MultiProcessIngester:
             self._remap(fused, m)
             if arch:
                 self._archive(arch)
+            if rec is not None and getattr(store, "_disk", None) is not None:
+                # remap the record's svc/rsvc/name/key lanes local ->
+                # global (the journal above already covers every id this
+                # chunk references) and append to the disk archive, so
+                # MP-ingested traces are raw-archived exactly like the
+                # sync fast path's (VERDICT r4 order 2)
+                rec = list(rec)
+                rec[7] = m.svc[rec[7]]
+                rec[8] = m.svc[rec[8]]
+                rec[9] = m.name[rec[9]]
+                rec[10] = m.key[rec[10]]
+                store.disk_append_record(tuple(rec))
             store.agg.ingest_fused(
                 fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
                 ts_range=ts_range,

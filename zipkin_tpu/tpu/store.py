@@ -86,6 +86,12 @@ class TpuStorage(
         from zipkin_tpu.parallel.sharded import ShardedAggregator
 
         self.config = config or AggConfig()
+        # NOTE: the archive index packs svc/rsvc ids into 16 bits each
+        # (tpu/archive.py COLS row 6). AggConfig already rejects
+        # max_services beyond the packed-wire 16-bit limit (state.py /
+        # columnar.MAX_WIRE_SERVICES), so a truncating capacity is
+        # unconstructable — pinned by
+        # tests/test_disk_archive.py::test_service_capacity_guard.
         self.strict_trace_id = strict_trace_id
         self.search_enabled = search_enabled
         self.autocomplete_keys = tuple(autocomplete_keys)
@@ -164,6 +170,9 @@ class TpuStorage(
         # the Python vocab (object path) assign ids sequentially; any
         # operation that interns must hold this lock so the orders match.
         self._intern_lock = threading.RLock()
+        # serializes vocab-sidecar persistence (snapshot + atomic
+        # replace) so concurrent writers cannot reorder replaces
+        self._persist_lock = threading.Lock()
         self._nvocab = None
         # read cache: device pulls (merged digest/sketches) keyed by the
         # write version, so repeated queries between writes cost nothing
@@ -231,7 +240,15 @@ class TpuStorage(
 
     def _persist_archive_vocab(self) -> None:
         """Write the vocab sidecar when it grew since the last write
-        (atomic rename; amortized to vocab growth, which is bounded)."""
+        (atomic rename; amortized to vocab growth, which is bounded).
+        The whole snapshot+write+replace runs under a dedicated persist
+        lock: without it a delayed writer (object path racing the sync
+        fast path) could os.replace a NEWER sidecar with an older
+        snapshot after `_archive_vocab_persisted` already moved past it
+        — a crash in that window would leave recovered segments holding
+        ids missing from the sidecar (ADVICE r4). The intern lock is
+        held only for the snapshot so persistence IO never stalls
+        line-rate interning."""
         if self._archive_vocab_path is None:
             return
         import json
@@ -239,29 +256,39 @@ class TpuStorage(
         import tempfile as _tempfile
 
         v = self.vocab
+        # lock-free pre-check: the overwhelmingly common call sees an
+        # unchanged vocab and must NOT queue behind a concurrent
+        # writer's sidecar IO (every disk append calls this)
         with self._intern_lock:
             size = len(v._key_list) + len(v.services._names) + len(
                 v.span_names._names
             )
             if size == self._archive_vocab_persisted:
                 return
-            with self._names_lock:
-                meta = {
-                    "services": list(v.services._names),
-                    "span_names": list(v.span_names._names),
-                    "keys": [list(k) for k in v._key_list],
-                    "local_svc_ids": sorted(self._local_svc_ids),
-                    "remote_by_svc": {
-                        str(k): sorted(vv)
-                        for k, vv in self._remote_by_svc.items()
-                    },
-                }
-            self._archive_vocab_persisted = size
-        d = _os.path.dirname(self._archive_vocab_path)
-        fd, tmp = _tempfile.mkstemp(dir=d, suffix=".json.tmp")
-        with _os.fdopen(fd, "w") as f:
-            json.dump(meta, f)
-        _os.replace(tmp, self._archive_vocab_path)
+        with self._persist_lock:
+            with self._intern_lock:
+                size = len(v._key_list) + len(v.services._names) + len(
+                    v.span_names._names
+                )
+                if size == self._archive_vocab_persisted:
+                    return
+                with self._names_lock:
+                    meta = {
+                        "services": list(v.services._names),
+                        "span_names": list(v.span_names._names),
+                        "keys": [list(k) for k in v._key_list],
+                        "local_svc_ids": sorted(self._local_svc_ids),
+                        "remote_by_svc": {
+                            str(k): sorted(vv)
+                            for k, vv in self._remote_by_svc.items()
+                        },
+                    }
+                self._archive_vocab_persisted = size
+            d = _os.path.dirname(self._archive_vocab_path)
+            fd, tmp = _tempfile.mkstemp(dir=d, suffix=".json.tmp")
+            with _os.fdopen(fd, "w") as f:
+                json.dump(meta, f)
+            _os.replace(tmp, self._archive_vocab_path)
 
     # -- SPI factories ---------------------------------------------------
 
@@ -425,6 +452,12 @@ class TpuStorage(
         """Device half of the fast path: raw-span archive + sharded ingest."""
         if self._disk is not None:
             self._disk_append_parsed(parsed)
+            if self.autocomplete_keys:
+                # autocompleteTags is served from the RAM archive only
+                # (the disk index has no tag lanes): keep the 1-in-N
+                # sample flowing or fast-path traffic would never
+                # surface tag values (ADVICE r4)
+                self._archive_fast_sample(parsed, parsed.n)
         else:
             self._archive_fast_sample(parsed, parsed.n)
         self.agg.ingest(cols)
@@ -433,46 +466,22 @@ class TpuStorage(
         """Write one fast-path chunk's raw spans + index columns to the
         disk archive. A chunk's spans are contiguous in the payload, so
         only that byte range is written (no duplication when a giant
-        payload chunks)."""
-        n = parsed.n
-        if n == 0:
+        payload chunks); sampler-punched holes compact to the kept
+        slices (see archive.parsed_record)."""
+        from zipkin_tpu.tpu.archive import parsed_record
+
+        rec = parsed_record(parsed)
+        if rec is None:
             return
-        off = parsed.span_off[:n].astype(np.uint64)
-        ln = parsed.span_len[:n].astype(np.uint64)
-        lo = int(off[0])
-        hi = int((off + ln).max())
-        span_bytes = int(ln.sum())
-        if span_bytes < (hi - lo) * 95 // 100:
-            # the sampler dropped spans between the kept ones: archiving
-            # the contiguous range would persist the dropped spans' raw
-            # bytes as unindexed garbage (at rate 0.1, ~90% of every
-            # segment). Compact to exactly the kept slices.
-            data = parsed.data
-            parts = [
-                bytes(data[int(o) : int(o) + int(l)])
-                for o, l in zip(off.tolist(), ln.tolist())
-            ]
-            payload = b"".join(parts)
-            new_off = np.concatenate(
-                [[0], np.cumsum(ln[:-1])]
-            ).astype(np.uint32)
-        else:
-            payload = bytes(parsed.data[lo:hi])
-            new_off = (off - lo).astype(np.uint32)
-        svc = parsed.svc_id[:n]
-        rsvc = parsed.rsvc_id[:n]
+        self.disk_append_record(rec)
+
+    def disk_append_record(self, rec: tuple) -> None:
+        """Append one prebuilt archive record (archive.parsed_record
+        tuple, GLOBAL vocab ids) — the seam the MP dispatcher uses to
+        feed worker-parsed batches into the disk archive."""
+        svc, rsvc = rec[7], rec[8]
         self._track_remotes(svc, rsvc)
-        self._disk.append_batch(
-            payload,
-            new_off, parsed.span_len[:n],
-            parsed.tl0[:n], parsed.tl1[:n], parsed.th0[:n], parsed.th1[:n],
-            svc, rsvc, parsed.name_id[:n], parsed.key_id[:n],
-            (parsed.ts_us[:n] // 60_000_000).astype(np.uint32),
-            np.where(parsed.has_dur[:n], parsed.dur_us[:n], 0).astype(
-                np.uint64
-            ),
-            parsed.err[:n],
-        )
+        self._disk.append_batch(*rec)
         self._persist_archive_vocab()
 
     def _track_remotes(self, svc: np.ndarray, rsvc: np.ndarray) -> None:
