@@ -201,32 +201,77 @@ def main() -> None:
     chronological = list(rates)  # all_passes keeps resampling order
     rates.sort()
 
-    # adversarial pass: one sweep of the churn corpus through the SAME
-    # path, right after the main measurement (so both see a comparable
-    # tunnel phase). A fresh store isolates its vocab overflow from the
-    # main run's vocab.
+    # adversarial leg: sweeps of the churn corpus through the SAME path,
+    # right after the main measurement. MULTI-WINDOW like the main leg
+    # (VERDICT r4 order 4): one sweep let a single bad relay window
+    # decide the record (r4 driver artifact: 1.27x vs 2.44x builder-side
+    # on the same build) — so >=3 passes run, ALL are reported, and the
+    # MEDIAN is the headline adversarial number; below-floor medians
+    # keep resampling with longer gaps until the wall budget runs out.
+    # A fresh store isolates its vocab overflow from the main run's
+    # vocab; later passes re-stream the same byte-unique corpus with
+    # overflow still live (the stress is per-pass span uniqueness +
+    # catch-all churn, which recycling across passes does not relax).
     adv = {}
     if adv_spans > 0 and mode in ("json", "mp"):
+        adv_passes_min = int(os.environ.get("BENCH_ADV_PASSES", 3))
+        adv_max_passes = int(os.environ.get("BENCH_ADV_MAX_PASSES", 6))
+        adv_floor = float(
+            os.environ.get("BENCH_ADV_FLOOR", 1.5 * BASELINE_PER_CHIP)
+        )
+        adv_max_wall_s = float(os.environ.get("BENCH_ADV_MAX_WALL_S", 300.0))
         adv_store = TpuStorage(
             config=config, mesh=mesh, pad_to_multiple=batch_size
         )
-        gen = adversarial_payloads(adv_spans, batch_size)
-        first = next(gen)
-        adv_store.warm(first)
-        start = time.perf_counter()
-        total = 0
-        accepted, _ = adv_store.ingest_json_fast(first)
-        total += accepted
-        for payload in gen:
-            accepted, _ = adv_store.ingest_json_fast(payload)
-            total += accepted
-        adv_store.agg.block_until_ready()
-        adv_rate = total / (time.perf_counter() - start)
+        adv_store.warm(next(adversarial_payloads(adv_spans, batch_size)))
+
+        def adv_pass() -> tuple:
+            start = time.perf_counter()
+            total = 0
+            for payload in adversarial_payloads(adv_spans, batch_size):
+                accepted, _ = adv_store.ingest_json_fast(payload)
+                total += accepted
+                # degraded-window passes are cut short exactly like the
+                # main leg's (the partial sweep is still a sustained
+                # rate); without this one bad window could blow the
+                # whole adversarial wall budget in a single pass
+                if time.perf_counter() - start > pass_abort_s:
+                    break
+            adv_store.agg.block_until_ready()
+            return total / (time.perf_counter() - start), total
+
+        import statistics
+
+        adv_rates = []
+        adv_span_total = 0
+        adv_deadline = time.monotonic() + adv_max_wall_s
+        while True:
+            adv_rate, adv_pass_spans = adv_pass()
+            adv_rates.append(adv_rate)
+            adv_span_total += adv_pass_spans
+            med = statistics.median(adv_rates)
+            if len(adv_rates) >= adv_passes_min and med >= adv_floor:
+                break
+            if (
+                len(adv_rates) >= adv_max_passes
+                or time.monotonic() >= adv_deadline
+            ):
+                break
+            time.sleep(
+                pass_gap_s if med >= adv_floor else degraded_gap_s
+            )
         counters = adv_store.ingest_counters()
+        adv_median = statistics.median(adv_rates)
         adv = {
-            "adversarial": round(adv_rate, 1),
-            "adversarial_vs_baseline": round(adv_rate / BASELINE_PER_CHIP, 3),
-            "adversarial_spans": total,
+            # the RECORD is the median across windows, per r4 order 4
+            "adversarial": round(adv_median, 1),
+            "adversarial_vs_baseline": round(
+                adv_median / BASELINE_PER_CHIP, 3
+            ),
+            "adversarial_best": round(max(adv_rates), 1),
+            "adversarial_passes": len(adv_rates),
+            "adversarial_all_passes": [round(r, 1) for r in adv_rates],
+            "adversarial_spans": adv_span_total,
             # proof the overflow path was actually live
             "adversarial_vocab_overflow": int(
                 counters["serviceVocabOverflow"]

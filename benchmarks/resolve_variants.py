@@ -1,0 +1,403 @@
+"""resolve_parents reformulations for the r5 fresh-read A/B.
+
+Three candidates against ops/linker.resolve_parents (V0):
+
+- **V1 — dual-channel coarse scans**: the two coarse run-min broadcasts
+  (shared-any, nonshared-any) share their run boundaries, so one
+  fwd+bwd scan pair carries BOTH value channels: 4 segmented scans
+  total instead of 6.
+- **V2 — half-ordered forward-only scans**: add a sub-half lane to the
+  sort key (nonshared table < shared table < query). Within every id
+  run, all candidate (table) lanes then PRECEDE every consumer lane, so
+  a forward-only segmented first-match scan replaces each fwd+bwd pair
+  — no backward passes, no flips. The svc-fine shared preference needs
+  its own key order (id, svc, half), so V2 pays a SECOND sort and two
+  extra unsort scatters to buy forward-only scans.
+- **V1r — V1 with associative_scan(reverse=True)** instead of explicit
+  flips (r4 measured a regression for one formulation; re-checked here
+  under device capture since wall timing was the r4 instrument).
+
+All must be BIT-IDENTICAL to V0 (asserted by tests and the harness).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zipkin_tpu.ops.linker import (
+    LinkInput,
+    _run_starts,
+    union_key_lanes,
+)
+from zipkin_tpu.ops.segments import segment_starts
+
+
+def _finish(x: LinkInput, parent):
+    """Shared tail of every variant (self-parent + validity + has_child)."""
+    n = x.valid.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    parent = jnp.where(parent == idx, -1, parent)
+    parent = jnp.where(x.valid, parent, -1)
+    has_child = (
+        jnp.zeros(n, jnp.int32)
+        .at[jnp.where(parent >= 0, parent, 0)]
+        .max(jnp.where(parent >= 0, 1, 0))
+    )
+    return parent, has_child.astype(bool)
+
+
+def _common(x: LinkInput):
+    n = x.valid.shape[0]
+    has_parent = ((x.p0 | x.p1) != 0) & x.valid
+    nonshared = x.valid & ~x.shared
+    sharedv = x.valid & x.shared
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seq = idx if x.seq is None else x.seq.astype(jnp.int32)
+    rank_to_idx = jnp.zeros(n, jnp.int32).at[seq].set(idx)
+    sent = 2 * n
+    far = jnp.full((n,), sent, jnp.int32)
+    val_sh = jnp.concatenate([jnp.where(sharedv, seq, sent), far])
+    val_ns = jnp.concatenate([jnp.where(nonshared, seq, sent), far])
+    qsh = jnp.concatenate([jnp.zeros((n,), bool), sharedv])
+    return (
+        n, has_parent, nonshared, sharedv, idx, seq, rank_to_idx, sent,
+        val_sh, val_ns, qsh,
+    )
+
+
+def _run_min_bcast2(v1, v2, starts, none):
+    """Per-run min of TWO channels over the same runs, broadcast to every
+    lane — one fwd+bwd scan pair carrying both values."""
+    ends = jnp.concatenate([starts[1:], jnp.ones((1,), bool)])
+
+    def combine(a, b):
+        fa, va1, va2 = a
+        fb, vb1, vb2 = b
+        return (
+            fa | fb,
+            jnp.where(fb, vb1, jnp.minimum(va1, vb1)),
+            jnp.where(fb, vb2, jnp.minimum(va2, vb2)),
+        )
+
+    _, f1, f2 = jax.lax.associative_scan(combine, (starts, v1, v2))
+    rv1 = jnp.flip(v1)
+    rv2 = jnp.flip(v2)
+    re = jnp.flip(ends)
+    _, b1, b2 = jax.lax.associative_scan(combine, (re, rv1, rv2))
+    b1 = jnp.flip(b1)
+    b2 = jnp.flip(b2)
+    o1 = jnp.minimum(f1, b1)
+    o2 = jnp.minimum(f2, b2)
+    return (
+        jnp.where(o1 >= none, -1, o1),
+        jnp.where(o2 >= none, -1, o2),
+    )
+
+
+def _run_min_bcast2_rev(v1, v2, starts, none):
+    """As _run_min_bcast2 but the backward pass uses
+    associative_scan(reverse=True) instead of explicit flips."""
+    ends = jnp.concatenate([starts[1:], jnp.ones((1,), bool)])
+
+    def combine(a, b):
+        fa, va1, va2 = a
+        fb, vb1, vb2 = b
+        return (
+            fa | fb,
+            jnp.where(fb, vb1, jnp.minimum(va1, vb1)),
+            jnp.where(fb, vb2, jnp.minimum(va2, vb2)),
+        )
+
+    def combine_rev(a, b):
+        # scanning right-to-left: `a` is the later (already-combined)
+        # suffix, `b` the earlier... associative_scan(reverse=True)
+        # still calls combine(left, right) on reversed segments, so the
+        # same combine works with ends as the reset flags of the LEFT
+        # element; easiest correct form: reuse combine on the flipped
+        # semantics by treating (ends, v) directly.
+        return combine(a, b)
+
+    _, f1, f2 = jax.lax.associative_scan(combine, (starts, v1, v2))
+    _, b1, b2 = jax.lax.associative_scan(
+        combine_rev, (ends, v1, v2), reverse=True
+    )
+    o1 = jnp.minimum(f1, b1)
+    o2 = jnp.minimum(f2, b2)
+    return (
+        jnp.where(o1 >= none, -1, o1),
+        jnp.where(o2 >= none, -1, o2),
+    )
+
+
+def resolve_v1(x: LinkInput, reverse_scan: bool = False):
+    """V0 with the two coarse broadcasts fused into one scan pair."""
+    (
+        n, has_parent, nonshared, sharedv, idx, seq, rank_to_idx, sent,
+        val_sh, val_ns, qsh,
+    ) = _common(x)
+    id_lanes, svc_lane, _ = union_key_lanes(x)
+    uidx = jnp.arange(2 * n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        tuple(id_lanes) + (svc_lane, val_sh, val_ns, qsh, uidx), num_keys=4
+    )
+    s_ids = sorted_ops[:3]
+    s_svc, sh_s, ns_s, s_qsh, sord = sorted_ops[3:]
+    coarse = _run_starts(list(s_ids))
+    fine = coarse | jnp.asarray(segment_starts(s_svc))
+    bcast2 = _run_min_bcast2_rev if reverse_scan else _run_min_bcast2
+    r_sh_any, r_ns_any = bcast2(sh_s, ns_s, coarse, sent)
+    from zipkin_tpu.ops.linker import _run_min_bcast
+
+    r_sh_fine = _run_min_bcast(sh_s, fine, sent)
+
+    primary = r_ns_any
+    p_idx = rank_to_idx[jnp.where(primary >= 0, primary, 0)]
+    primary_svc = x.svc[p_idx].astype(jnp.uint32)
+    primary_matches = (primary >= 0) & (primary_svc == s_svc)
+    by_parent_id = primary
+    by_parent_id = jnp.where(r_sh_any >= 0, r_sh_any, by_parent_id)
+    by_parent_id = jnp.where(primary_matches, primary, by_parent_id)
+    by_parent_id = jnp.where(r_sh_fine >= 0, r_sh_fine, by_parent_id)
+
+    is_table = sord < n
+    combined = jnp.where(is_table | s_qsh, r_ns_any, by_parent_id)
+    inv = jnp.zeros(2 * n, jnp.int32).at[sord].set(combined)
+    un = jnp.where(inv >= 0, rank_to_idx[jnp.where(inv >= 0, inv, 0)], -1)
+    j_shared = jnp.where(sharedv, un[:n], -1)
+    q = jnp.where(has_parent, un[n:], -1)
+    parent = jnp.where(sharedv, jnp.where(j_shared >= 0, j_shared, q), q)
+    return _finish(x, parent)
+
+
+def _fwd_min_scan(vals, starts):
+    """Forward-only segmented inclusive min scan."""
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, jnp.minimum(va, vb))
+
+    _, v = jax.lax.associative_scan(combine, (starts, vals))
+    return v
+
+
+def _fwd_min_scan2(v1, v2, starts):
+    def combine(a, b):
+        fa, va1, va2 = a
+        fb, vb1, vb2 = b
+        return (
+            fa | fb,
+            jnp.where(fb, vb1, jnp.minimum(va1, vb1)),
+            jnp.where(fb, vb2, jnp.minimum(va2, vb2)),
+        )
+
+    _, o1, o2 = jax.lax.associative_scan(combine, (starts, v1, v2))
+    return o1, o2
+
+
+def resolve_v2(x: LinkInput):
+    """Two sorts, forward-only scans (see module docstring).
+
+    Sort A key: (id, subhalf) with subhalf nonshared-table(0) <
+    shared-table(1) < query(2): every consumer lane's candidates sort
+    BEFORE it inside its id run, so a forward scan sees them all.
+    Sort B key: (id, svc, halfB) with shared-table(0) < others(1): the
+    svc-matched shared preference via forward scan at fine granularity.
+    """
+    (
+        n, has_parent, nonshared, sharedv, idx, seq, rank_to_idx, sent,
+        val_sh, val_ns, qsh,
+    ) = _common(x)
+    id_lanes, svc_lane, _ = union_key_lanes(x)
+    uidx = jnp.arange(2 * n, dtype=jnp.int32)
+
+    subhalf = jnp.concatenate([
+        jnp.where(sharedv, jnp.uint32(1), jnp.uint32(0)),
+        jnp.full((n,), 2, jnp.uint32),
+    ])
+    sortedA = jax.lax.sort(
+        tuple(id_lanes) + (subhalf, val_sh, val_ns, uidx), num_keys=4
+    )
+    a_ids = sortedA[:3]
+    a_sh, a_ns, a_ord = sortedA[4], sortedA[5], sortedA[6]
+    startsA = _run_starts(list(a_ids))
+    sh_any_s, ns_any_s = _fwd_min_scan2(a_sh, a_ns, startsA)
+    # unsort both channels
+    sh_any = jnp.zeros(2 * n, jnp.int32).at[a_ord].set(sh_any_s)
+    ns_any = jnp.zeros(2 * n, jnp.int32).at[a_ord].set(ns_any_s)
+
+    halfB = jnp.concatenate([
+        jnp.where(sharedv, jnp.uint32(0), jnp.uint32(1)),
+        jnp.ones((n,), jnp.uint32),
+    ])
+    sortedB = jax.lax.sort(
+        tuple(id_lanes) + (svc_lane, halfB, val_sh, uidx), num_keys=5
+    )
+    b_ids = sortedB[:3]
+    b_svc, b_sh, b_ord = sortedB[3], sortedB[5], sortedB[6]
+    startsB = _run_starts(list(b_ids)) | jnp.asarray(segment_starts(b_svc))
+    sh_fine_s = _fwd_min_scan(b_sh, startsB)
+    sh_fine = jnp.zeros(2 * n, jnp.int32).at[b_ord].set(sh_fine_s)
+
+    def dec(v):
+        return jnp.where(v >= sent, -1, v)
+
+    # selection in UNSORTED space, per original lane
+    sh_any, ns_any, sh_fine = dec(sh_any), dec(ns_any), dec(sh_fine)
+    q_sh_any, q_ns_any, q_sh_fine = sh_any[n:], ns_any[n:], sh_fine[n:]
+    primary = q_ns_any
+    p_idx = rank_to_idx[jnp.where(primary >= 0, primary, 0)]
+    primary_svc = x.svc[p_idx].astype(jnp.uint32)
+    primary_matches = (primary >= 0) & (
+        primary_svc == x.svc.astype(jnp.uint32)
+    )
+    by_parent_id = primary
+    by_parent_id = jnp.where(q_sh_any >= 0, q_sh_any, by_parent_id)
+    by_parent_id = jnp.where(primary_matches, primary, by_parent_id)
+    by_parent_id = jnp.where(q_sh_fine >= 0, q_sh_fine, by_parent_id)
+
+    # query lanes of shared spans consult only primary_by_id; table
+    # lanes (the shared->client join) use the nonshared-any channel of
+    # their OWN-id run
+    q_combined = jnp.where(sharedv, q_ns_any, by_parent_id)
+    t_combined = ns_any[:n]
+
+    def to_lane(v):
+        return jnp.where(v >= 0, rank_to_idx[jnp.where(v >= 0, v, 0)], -1)
+
+    j_shared = jnp.where(sharedv, to_lane(t_combined), -1)
+    q = jnp.where(has_parent, to_lane(q_combined), -1)
+    parent = jnp.where(sharedv, jnp.where(j_shared >= 0, j_shared, q), q)
+    return _finish(x, parent)
+
+
+def _hash_pair(a, b):
+    """32-bit avalanche of a u32 pair (same recipe as ops/hashing.hash2)."""
+    from zipkin_tpu.ops import hashing
+
+    return hashing.hash2(a.astype(jnp.uint32), b.astype(jnp.uint32))
+
+
+def resolve_v3(x: LinkInput):
+    """Lean-operand sort: span ids hashed to ONE u32 key lane (false
+    join needs a 32-bit trace-hash collision AND a 32-bit span-id-hash
+    collision in one ring — the same odds argument union_key_lanes makes
+    for trace ids), and the query-shared flag folded into the val_sh
+    lane's sentinel band (sent+1) so the sort carries 6 operands instead
+    of 8. Everything after the sort is V0's selection, on 2 id lanes."""
+    (
+        n, has_parent, nonshared, sharedv, idx, seq, rank_to_idx, sent,
+        val_sh, val_ns, qsh,
+    ) = _common(x)
+    anyvalid = jnp.concatenate([x.valid, has_parent])
+
+    def lane(t, q):
+        return jnp.where(
+            anyvalid,
+            jnp.concatenate([t.astype(jnp.uint32), q.astype(jnp.uint32)]),
+            jnp.uint32(0xFFFFFFFF),
+        )
+
+    sid_h = _hash_pair(x.s0, x.s1)
+    pid_h = _hash_pair(x.p0, x.p1)
+    id0 = lane(x.trace_h, x.trace_h)
+    id1 = lane(sid_h, pid_h)
+    svc_lane = lane(x.svc.astype(jnp.uint32), x.svc.astype(jnp.uint32))
+    # query lanes carry sent(+1 when shared) in the val_sh lane: still
+    # >= sent for every run-min, and the shared flag survives the sort
+    val_sh_q = jnp.concatenate([
+        jnp.where(sharedv, seq, sent),
+        jnp.where(sharedv, sent + 1, sent),
+    ])
+    uidx = jnp.arange(2 * n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        (id0, id1, svc_lane, val_sh_q, val_ns, uidx), num_keys=3
+    )
+    s_id0, s_id1, s_svc, sh_s, ns_s, sord = sorted_ops
+    coarse = _run_starts([s_id0, s_id1])
+    fine = coarse | jnp.asarray(segment_starts(s_svc))
+
+    from zipkin_tpu.ops.linker import _run_min_bcast
+
+    r_sh_fine = _run_min_bcast(sh_s, fine, sent)
+    r_sh_any = _run_min_bcast(sh_s, coarse, sent)
+    r_ns_any = _run_min_bcast(ns_s, coarse, sent)
+
+    s_qsh = sh_s == sent + 1
+    primary = r_ns_any
+    p_idx = rank_to_idx[jnp.where(primary >= 0, primary, 0)]
+    primary_svc = x.svc[p_idx].astype(jnp.uint32)
+    primary_matches = (primary >= 0) & (primary_svc == s_svc)
+    by_parent_id = primary
+    by_parent_id = jnp.where(r_sh_any >= 0, r_sh_any, by_parent_id)
+    by_parent_id = jnp.where(primary_matches, primary, by_parent_id)
+    by_parent_id = jnp.where(r_sh_fine >= 0, r_sh_fine, by_parent_id)
+
+    is_table = sord < n
+    combined = jnp.where(is_table | s_qsh, r_ns_any, by_parent_id)
+    inv = jnp.zeros(2 * n, jnp.int32).at[sord].set(combined)
+    un = jnp.where(inv >= 0, rank_to_idx[jnp.where(inv >= 0, inv, 0)], -1)
+    j_shared = jnp.where(sharedv, un[:n], -1)
+    q = jnp.where(has_parent, un[n:], -1)
+    parent = jnp.where(sharedv, jnp.where(j_shared >= 0, j_shared, q), q)
+    return _finish(x, parent)
+
+
+def chase_v2(parent: jnp.ndarray, kind: jnp.ndarray):
+    """chase_ancestors with the two pointer arrays fused into ONE
+    [2(n+1)] array so each doubling pass is a single gather (the jump
+    half points into [0, n+1), the root half into [n+1, 2n+2))."""
+    n = parent.shape[0]
+    sent = n
+    par = jnp.where(parent >= 0, parent, sent)
+    kind_ext = jnp.concatenate([kind, jnp.zeros((1,), kind.dtype)])
+    par_ext = jnp.concatenate([par, jnp.full((1,), sent, par.dtype)])
+    jump = jnp.where(kind_ext != 0, jnp.arange(n + 1), par_ext)
+    jump = jump.at[sent].set(sent)
+    off = n + 1
+    arr = jnp.concatenate([jump, par_ext + off])
+    max_passes = max((n).bit_length(), 1)
+
+    def cond(c):
+        i, _, changed = c
+        return changed & (i < max_passes)
+
+    def body(c):
+        i, arr, _ = c
+        a2 = arr[arr]
+        changed = jnp.any(a2 != arr)
+        return i + 1, a2, changed
+
+    _, arr, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), arr, jnp.any(arr >= 0))
+    )
+    jump = arr[:off]
+    root = arr[off:] - off
+    anc = jump[par]
+    anc = jnp.where(anc == sent, -1, anc)
+    anc = jnp.where(
+        (anc >= 0) & (kind_ext[jnp.where(anc >= 0, anc, 0)] != 0), anc, -1
+    )
+    return anc, root[:n] == sent
+
+
+def emit_v2(ctx, emit, num_services: int):
+    """emit_links with the main and rule-6b edges concatenated into ONE
+    scatter-add per matrix (2 scatters instead of 4)."""
+    s = num_services
+    pc = jnp.clip(ctx.par_svc, 0, s - 1)
+    cc = jnp.clip(ctx.child_svc, 0, s - 1)
+    bc = jnp.clip(ctx.anc_svc, 0, s - 1)
+    lc = jnp.clip(ctx.local, 0, s - 1)
+    rows = jnp.concatenate([pc, bc])
+    cols = jnp.concatenate([cc, lc])
+    ok = jnp.concatenate([ctx.ok & emit, ctx.back & emit]).astype(jnp.uint32)
+    er = jnp.concatenate(
+        [ctx.err & emit, jnp.zeros_like(ctx.back)]
+    ).astype(jnp.uint32)
+    calls = jnp.zeros((s, s), jnp.uint32).at[rows, cols].add(ok)
+    errors = jnp.zeros((s, s), jnp.uint32).at[rows, cols].add(er)
+    return calls, errors

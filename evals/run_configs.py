@@ -345,45 +345,102 @@ def linker_traces(linker) -> int:
 
 
 def config3() -> bool:
-    import jax
-    import jax.numpy as jnp
-
-    from zipkin_tpu.ops import hashing, hll
+    """HLL cardinality at 100M distinct trace ids THROUGH THE PRODUCTION
+    INGEST PATH (VERDICT r4 order 5): spans with distinct ids stream
+    through ``ShardedAggregator.ingest`` — the same fused jit'd
+    ingest_step production traffic takes, with the HLL update inside it
+    and the estimate read via the production psum/pmax merge program —
+    not a bare ``hll.update`` loop on standalone registers. The rate
+    reported is therefore FULL ingest-step throughput (digests, links,
+    histograms all live), not an HLL-only number; both the global row
+    and the per-service rows gate."""
+    from zipkin_tpu.ops import hll
+    from zipkin_tpu.parallel.mesh import make_mesh
+    from zipkin_tpu.parallel.sharded import ShardedAggregator
+    from zipkin_tpu.tpu.columnar import SpanColumns, _hash2_np
+    from zipkin_tpu.tpu.state import AggConfig
 
     total = int(os.environ.get("EVAL_HLL", 100_000_000))
-    batch = 1_000_000
-    regs = hll.new_registers(1, precision=11)
-    upd = jax.jit(hll.update, donate_argnums=0)
-    rows = jnp.zeros(batch, jnp.int32)
-    valid = jnp.ones(batch, bool)
+    batch = 65_536
+    n_services = 32
+    cfg = AggConfig()
+    agg = ShardedAggregator(cfg, mesh=make_mesh(1))
+    u0 = np.zeros(batch, np.uint32)
+    hi32 = _hash2_np(u0, u0)  # th lanes are zero: production trace_h rule
+    valid = np.ones(batch, bool)
+    zi32 = np.zeros(batch, np.int32)
+    zb = np.zeros(batch, bool)
+    lane = np.arange(batch, dtype=np.uint64)
+
+    def cols_at(done: int) -> SpanColumns:
+        i64 = np.uint64(done + 1) + lane  # distinct 64-bit trace ids
+        tl0 = (i64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        tl1 = (i64 >> np.uint64(32)).astype(np.uint32)
+        svc = (i64 % np.uint64(n_services)).astype(np.int32) + 1
+        return SpanColumns(
+            trace_h=_hash2_np(_hash2_np(tl0, tl1), hi32),
+            tl0=tl0, tl1=tl1, s0=tl0, s1=u0, p0=u0, p1=u0,
+            shared=zb, kind=zi32, svc=svc, rsvc=zi32,
+            key=(i64 % np.uint64(200)).astype(np.int32) + 1,
+            err=zb, dur=(tl0 % np.uint32(10_000)) + np.uint32(1),
+            has_dur=valid, ts_min=np.full(batch, 29_000_000, np.uint32),
+            valid=valid,
+        )
+
+    agg.ingest(cols_at(0))  # warm: compiles outside the timed window
+    agg.block_until_ready()
+    done = batch
     start = time.perf_counter()
-    for i in range(total // batch):
-        # distinct 32-bit-pair ids -> full-avalanche hashes on device
-        lo = jnp.arange(i * batch, (i + 1) * batch, dtype=jnp.uint32)
-        hi = jnp.full((batch,), i >> 32, jnp.uint32)
-        regs = upd(regs, rows, hashing.hash2(hi, lo), valid)
-    regs.block_until_ready()
+    while done < total:
+        agg.ingest(cols_at(done))
+        done += batch
+    agg.block_until_ready()
     elapsed = time.perf_counter() - start
-    est = float(hll.estimate(regs)[0])
-    err = abs(est - total) / total
-    ok = err < 3 * hll.standard_error(11)
-    _emit(config="config3", passed=ok, ids=total, estimate=round(est),
-          rel_err=round(err, 5), updates_per_sec=round(total / elapsed))
+    est_rows = agg.cardinalities()  # production read: pmax merge on device
+    est = float(est_rows[cfg.global_hll_row])
+    err = abs(est - done) / done
+    bound = 3 * hll.standard_error(cfg.hll_precision)
+    per_svc = est_rows[1 : n_services + 1]
+    svc_true = done / n_services
+    svc_err = float(np.abs(per_svc - svc_true).max() / svc_true)
+    ok = err < bound and svc_err < bound
+    _emit(config="config3", passed=ok, ids=done, estimate=round(est),
+          rel_err=round(err, 5), worst_service_rel_err=round(svc_err, 5),
+          path="ShardedAggregator.ingest (production fused step)",
+          ingest_spans_per_sec=round((done - batch) / elapsed))
     return ok
 
 
 def config4() -> bool:
     """Streaming replay + mixed Lens query load at full-size AggConfig.
 
-    Uses the line-rate JSON path (the production fast mode, sampled
-    archive on) with a pre-encoded recycled corpus, so the harness can
-    reach tens of millions of spans. Query latency is measured two ways
-    and BOTH gate the verdict: mid-stream (queueing behind the async
-    ingest pipeline — bounded by ~8 in-flight batches, gated at p50 <
-    2s) and quiesced (the query programs themselves, gated at the <50ms
-    p50 SLO). min/p50/p99 all reported; the tunneled backend adds
+    r5 (VERDICT r4 order 1) makes the replay REAL rather than a
+    recycled soak:
+
+    - **Distinct identities at line rate**: the corpus is one encoded
+      template whose trace ids carry a fixed 8-hex prefix; every batch
+      byte-patches the prefix, so ~1B DISTINCT trace ids stream through
+      dedup/HLL/archive (the archive_soak technique). The device HLL
+      estimate is gated against the exact distinct count.
+    - **Vocab churn at/over capacity**: service and span names embed a
+      rotation token patched every EVAL_ROTATE_EVERY batches, so the
+      cumulative key space runs far past max_services/max_keys and the
+      per-service catch-all overflow path stays live for most of the
+      run (gated: overflow counters must be nonzero at full scale).
+    - **The disk archive runs LIVE on the ingest path** (budget-bounded;
+      retention expected at 1B), and in-window complete-trace probes
+      gate — "every acked trace queryable" is exercised at flagship
+      scale, not in a separate soak.
+
+    Query latency is measured two ways and BOTH gate the verdict:
+    mid-stream (queueing behind the async ingest pipeline — in-flight
+    depth bounded by EVAL_SYNC_EVERY_BATCHES) and quiesced (the query
+    programs themselves, gated at the <50ms p50 SLO via XPlane device
+    capture). min/p50/p99 all reported; the tunneled backend adds
     latency a real v5e topology doesn't have.
     """
+    import dataclasses
+
     from tests.fixtures import lots_of_spans
     from zipkin_tpu import native
     from zipkin_tpu.model import json_v2
@@ -407,6 +464,26 @@ def config4() -> bool:
     # growth bounded, not just throughput (VERDICT r3 order 3)
     durable_dir = os.environ.get("EVAL_REPLAY_DURABLE")
     snap_every = int(os.environ.get("EVAL_SNAPSHOT_EVERY_BATCHES", 448))
+    # disk archive on the ingest path (r5): default ON at full scale,
+    # budget-bounded so retention churns live; EVAL_ARCHIVE_DIR=off
+    # disables (for A/B), EVAL_ARCHIVE_BYTES sets the budget
+    arc_env = os.environ.get("EVAL_ARCHIVE_DIR", "")
+    if arc_env.lower() in ("off", "none", "0"):
+        arc_dir = None
+    elif arc_env:
+        arc_dir = arc_env
+    else:
+        import tempfile as _tf
+
+        arc_dir = _tf.mkdtemp(prefix="config4_archive_")
+    arc_bytes = int(os.environ.get("EVAL_ARCHIVE_BYTES", 12 << 30))
+    arc_kw = dict(
+        archive_dir=arc_dir, archive_max_bytes=arc_bytes,
+    ) if arc_dir else {}
+    # bound the async dispatch queue: sync every N batches so mid-stream
+    # queries never queue behind an unbounded pipeline (r4's 488/500ms
+    # whisker margin was mostly queue depth); 0 disables
+    sync_every = int(os.environ.get("EVAL_SYNC_EVERY_BATCHES", 4))
     if durable_dir:
         from zipkin_tpu.storage.tpu import TpuStorage as _Durable
 
@@ -415,17 +492,61 @@ def config4() -> bool:
             max_span_count=100_000,
             checkpoint_dir=durable_dir + "/snap",
             wal_dir=durable_dir + "/wal",
+            **arc_kw,
         )
     else:
         store = TpuStorage(
             config=cfg, mesh=make_mesh(1), pad_to_multiple=batch,
             archive_max_span_count=100_000,
+            **arc_kw,
         )
-    corpus = lots_of_spans(2 * batch, seed=400, services=40, span_names=80)
-    payloads = [
-        json_v2.encode_span_list(corpus[i : i + batch])
-        for i in range(0, len(corpus), batch)
-    ]
+    # template with patchable identity + rotation tokens: trace ids get
+    # a fixed hex prefix (patched per batch -> fresh ids), service/span
+    # names embed "roto0000" (patched per rotation epoch -> vocab churn)
+    rotate_every = int(os.environ.get("EVAL_ROTATE_EVERY", 256))
+    raw_corpus = lots_of_spans(batch, seed=400, services=40, span_names=80)
+
+    def _tok(ep):  # 8 chars, non-hex prefix so it never collides with ids
+        return f"rt{ep:06x}"
+
+    template = []
+    for s in raw_corpus:
+        ep = dataclasses.replace(
+            s.local_endpoint, service_name=s.local_service_name + "-roto0000"
+        )
+        rep = (
+            dataclasses.replace(
+                s.remote_endpoint,
+                service_name=s.remote_service_name + "-roto0000",
+            )
+            if s.remote_endpoint is not None
+            else None
+        )
+        template.append(
+            dataclasses.replace(
+                s, trace_id="feedface" + s.trace_id[8:],
+                name=(s.name or "op") + "-roto0000",
+                local_endpoint=ep, remote_endpoint=rep,
+            )
+        )
+    payload_t = json_v2.encode_span_list(template)
+    # exact distinct-trace count per patched batch (suffix collisions
+    # inside the template are counted once; prefixes are disjoint)
+    distinct_per_batch = len({s.trace_id for s in template})
+    probe_tid_t = template[0].trace_id
+    probe_n = sum(1 for x in template if x.trace_id == probe_tid_t)
+
+    rotate_every = max(rotate_every, 1)
+
+    def patched(it: int):
+        tag = f"{0x10000000 + it:08x}".encode()
+        rot = _tok(it // rotate_every).encode()
+        return (
+            payload_t.replace(b"feedface", tag).replace(b"roto0000", rot),
+            probe_tid_t.replace("feedface", tag.decode()),
+        )
+
+    corpus = template
     end_ts = max(s.timestamp for s in corpus if s.timestamp) // 1000 + 3_600_000
     lookback = 1000 * 86_400_000
     fast = native.available()
@@ -433,7 +554,7 @@ def config4() -> bool:
         # warm EVERY program the stream can hit (all fused step variants
         # + flush + rollup) — first compiles through the remote-compile
         # tunnel take minutes and must not land inside the measurement
-        store.warm(payloads[0])
+        store.warm(payload_t)
         sent = store.ingest_counters()["spans"]
     else:  # pragma: no cover - no C toolchain
         sent = 0
@@ -490,18 +611,41 @@ def config4() -> bool:
             v.clear()
 
     warm = sent  # spans ingested before the timed window opened
+    probe_every = int(os.environ.get("EVAL_PROBE_EVERY", 64))
+    probes: list = []
+    probes_incomplete = 0
+    acked: list = []  # patched probe tids, oldest first (bounded)
+    distinct_traces = 0
     start = time.perf_counter()
     while sent < total:
         if fast:
-            n, _ = store.ingest_json_fast(payloads[batches % len(payloads)])
+            payload, tid = patched(batches)
+            n, _ = store.ingest_json_fast(payload)
+            acked.append(tid)
+            distinct_traces += distinct_per_batch
         else:  # pragma: no cover
             chunk = corpus[:batch]
             store.accept(chunk).execute()
             n = len(chunk)
         sent += n
         batches += 1
+        if sync_every and batches % sync_every == 0:
+            # bound the in-flight dispatch queue (see docstring)
+            store.agg.block_until_ready()
         if batches % 8 == 0:  # mixed query load mid-stream
             query_round(lat)
+        if fast and arc_dir and batches % probe_every == 0:
+            # complete-trace probe of a trace acked ~half a window ago:
+            # recent enough to be in archive retention, old enough to
+            # prove the ack was durable, under full ingest load
+            probe = acked[max(0, len(acked) - probe_every // 2 - 1)]
+            p0 = time.perf_counter()
+            got = store.get_trace(probe).execute()
+            probes.append((time.perf_counter() - p0) * 1e3)
+            if len(got) != probe_n:
+                probes_incomplete += 1
+            if len(acked) > 4 * probe_every:
+                del acked[: 2 * probe_every]
         if durable_dir and batches % snap_every == 0:
             # the durability plane under load: snapshot clones the state
             # on device (ms under the lock), pulls lock-free, truncates
@@ -652,10 +796,73 @@ def config4() -> bool:
     )
     slo_ok = slo_program_ok and load_ok and fresh_ok
     trace_readable = bool(store.get_service_names().execute())
+
+    # r5 realism gates (VERDICT r4 order 1) ------------------------------
+    # (a) HLL vs the EXACT distinct-trace count (disjoint byte-patched
+    #     prefixes make it closed-form); warm replays the template once
+    #     more, contributing its distinct set a second time (same ids)
+    hll_gate = None
+    if fast and distinct_traces:
+        true_distinct = distinct_traces + distinct_per_batch  # + warm
+        from zipkin_tpu.ops import hll as _hll
+
+        est = store.trace_cardinalities()["_global"]
+        hll_err = abs(est - true_distinct) / true_distinct
+        hll_bound = 3 * _hll.standard_error(cfg.hll_precision)
+        hll_gate = {
+            "distinct_trace_ids": true_distinct,
+            "hll_estimate": round(est),
+            "rel_err": round(hll_err, 5),
+            "bound_3sigma": round(hll_bound, 5),
+            "passed": hll_err < hll_bound,
+        }
+    # (b) complete-trace probes from the live archive under load
+    probe_gate = None
+    if fast and arc_dir and probes:
+        ps = sorted(probes)
+        probe_gate = {
+            "probes": len(probes),
+            "incomplete": probes_incomplete,
+            "p50_ms": round(ps[len(ps) // 2], 1),
+            "max_ms": round(ps[-1], 1),
+            "passed": probes_incomplete == 0,
+        }
+    # (c) vocab churn kept the catch-all overflow path live whenever the
+    #     rotation schedule pushed past capacity
+    epochs = batches // rotate_every + 1
+    # per-epoch vocab footprint derived from the template itself (every
+    # epoch re-interns the same shape under rotated names)
+    svcs_per_epoch = len(
+        {s.local_service_name for s in template}
+        | {s.remote_service_name for s in template if s.remote_service_name}
+    )
+    keys_per_epoch = len(
+        {(s.local_service_name, s.name) for s in template}
+    )
+    churn_expected = fast and (
+        svcs_per_epoch * epochs > cfg.max_services
+        or keys_per_epoch * epochs > cfg.max_keys
+    )
+    overflow_seen = int(
+        counters.get("serviceVocabOverflow", 0)
+        + counters.get("keyVocabOverflow", 0)
+        + counters.get("nativeVocabOverflow", 0)
+    )
+    churn_gate = None
+    if churn_expected:
+        churn_gate = {
+            "rotation_epochs": epochs,
+            "vocab_overflow_updates": overflow_seen,
+            "passed": overflow_seen > 0,
+        }
+    realism_ok = all(
+        g is None or g["passed"] for g in (hll_gate, probe_gate, churn_gate)
+    )
     ok = (
         counters["spans"] == sent
         and bool(lat["dependencies"])
         and trace_readable  # fast mode must stay queryable (r1 gap)
+        and realism_ok
     )
     durability = None
     if durable_dir:
@@ -675,9 +882,20 @@ def config4() -> bool:
             "wal_bytes_final": _du(durable_dir + "/wal"),
             "snapshot_bytes_final": _du(durable_dir + "/snap"),
         }
+    archive_stats = None
+    if arc_dir:
+        archive_stats = {
+            k: v for k, v in counters.items() if k.startswith("archive")
+        }
     _emit(config="config4", passed=bool(ok and slo_ok), spans=sent,
           fast_path=fast,
           sustained_spans_per_sec=round((sent - warm) / elapsed),
+          distinct_identity_gate=hll_gate,
+          archive_probe_gate=probe_gate,
+          vocab_churn_gate=churn_gate,
+          archive=archive_stats,
+          rotate_every_batches=rotate_every,
+          sync_every_batches=sync_every,
           query_rounds=len(lat["dependencies"]),
           query_latency_under_load_ms=q_stats,
           query_latency_quiesced_ms=quiesced_stats,
