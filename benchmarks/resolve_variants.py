@@ -401,3 +401,123 @@ def emit_v2(ctx, emit, num_services: int):
     calls = jnp.zeros((s, s), jnp.uint32).at[rows, cols].add(ok)
     errors = jnp.zeros((s, s), jnp.uint32).at[rows, cols].add(er)
     return calls, errors
+
+
+def _run_min_ladder(channels, starts, none):
+    """All-channel segmented run-min BROADCAST via a flat shift-doubling
+    ladder: ceil(log2 n) steps, each one fused elementwise kernel
+    (min over self, left-neighbor-at-d, right-neighbor-at-d, guarded by
+    run identity), replacing the associative_scan up/down sweeps. After
+    the ladder every lane holds its run's full min in every channel."""
+    n = starts.shape[0]
+    run_id = jnp.cumsum(starts.astype(jnp.int32))
+    vs = [c for c in channels]
+    steps = max(int(n - 1).bit_length(), 1)
+    inf = jnp.int32(none)
+    for k in range(steps):
+        d = 1 << k
+        if d >= n:
+            break
+        rid_l = jnp.concatenate([jnp.full((d,), -1, jnp.int32), run_id[:-d]])
+        rid_r = jnp.concatenate([run_id[d:], jnp.full((d,), -2, jnp.int32)])
+        ok_l = run_id == rid_l
+        ok_r = run_id == rid_r
+        new = []
+        for v in vs:
+            lv = jnp.concatenate([jnp.full((d,), inf), v[:-d]])
+            rv = jnp.concatenate([v[d:], jnp.full((d,), inf)])
+            v = jnp.minimum(v, jnp.where(ok_l, lv, inf))
+            v = jnp.minimum(v, jnp.where(ok_r, rv, inf))
+            new.append(v)
+        vs = new
+    return [jnp.where(v >= none, -1, v) for v in vs]
+
+
+def resolve_v4(x: LinkInput):
+    """V0's single sort + the shift-doubling ladder for ALL THREE
+    run-min broadcasts (coarse pair at id granularity, fine at id+svc).
+    Two ladders (different run identities), each all-channel fused."""
+    (
+        n, has_parent, nonshared, sharedv, idx, seq, rank_to_idx, sent,
+        val_sh, val_ns, qsh,
+    ) = _common(x)
+    id_lanes, svc_lane, _ = union_key_lanes(x)
+    uidx = jnp.arange(2 * n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        tuple(id_lanes) + (svc_lane, val_sh, val_ns, qsh, uidx), num_keys=4
+    )
+    s_ids = sorted_ops[:3]
+    s_svc, sh_s, ns_s, s_qsh, sord = sorted_ops[3:]
+    coarse = _run_starts(list(s_ids))
+    fine = coarse | jnp.asarray(segment_starts(s_svc))
+    r_sh_any, r_ns_any = _run_min_ladder([sh_s, ns_s], coarse, sent)
+    (r_sh_fine,) = _run_min_ladder([sh_s], fine, sent)
+
+    primary = r_ns_any
+    p_idx = rank_to_idx[jnp.where(primary >= 0, primary, 0)]
+    primary_svc = x.svc[p_idx].astype(jnp.uint32)
+    primary_matches = (primary >= 0) & (primary_svc == s_svc)
+    by_parent_id = primary
+    by_parent_id = jnp.where(r_sh_any >= 0, r_sh_any, by_parent_id)
+    by_parent_id = jnp.where(primary_matches, primary, by_parent_id)
+    by_parent_id = jnp.where(r_sh_fine >= 0, r_sh_fine, by_parent_id)
+
+    is_table = sord < n
+    combined = jnp.where(is_table | s_qsh, r_ns_any, by_parent_id)
+    inv = jnp.zeros(2 * n, jnp.int32).at[sord].set(combined)
+    un = jnp.where(inv >= 0, rank_to_idx[jnp.where(inv >= 0, inv, 0)], -1)
+    j_shared = jnp.where(sharedv, un[:n], -1)
+    q = jnp.where(has_parent, un[n:], -1)
+    parent = jnp.where(sharedv, jnp.where(j_shared >= 0, j_shared, q), q)
+    return _finish(x, parent)
+
+
+def _run_min_ladder_multi(channel_runs, none):
+    """The PRODUCTION ladder (imported, not copied): the harness must
+    benchmark exactly what ships, or a retune of the production ladder
+    would leave this A/B validating stale code."""
+    from zipkin_tpu.ops.linker import _run_min_ladder
+
+    return _run_min_ladder(channel_runs, none)
+
+
+def resolve_v5(x: LinkInput):
+    """V4 with the coarse and fine ladders FUSED into one (per-channel
+    run identities), so every doubling step is a single fused kernel
+    over all three channels."""
+    (
+        n, has_parent, nonshared, sharedv, idx, seq, rank_to_idx, sent,
+        val_sh, val_ns, qsh,
+    ) = _common(x)
+    id_lanes, svc_lane, _ = union_key_lanes(x)
+    uidx = jnp.arange(2 * n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        tuple(id_lanes) + (svc_lane, val_sh, val_ns, qsh, uidx), num_keys=4
+    )
+    s_ids = sorted_ops[:3]
+    s_svc, sh_s, ns_s, s_qsh, sord = sorted_ops[3:]
+    coarse = _run_starts(list(s_ids))
+    fine = coarse | jnp.asarray(segment_starts(s_svc))
+    rid_c = jnp.cumsum(coarse.astype(jnp.int32))
+    rid_f = jnp.cumsum(fine.astype(jnp.int32))
+    r_sh_any, r_ns_any, r_sh_fine = _run_min_ladder_multi(
+        [(sh_s, rid_c), (ns_s, rid_c), (sh_s, rid_f)], sent
+    )
+
+    primary = r_ns_any
+    p_idx = rank_to_idx[jnp.where(primary >= 0, primary, 0)]
+    primary_svc = x.svc[p_idx].astype(jnp.uint32)
+    primary_matches = (primary >= 0) & (primary_svc == s_svc)
+    by_parent_id = primary
+    by_parent_id = jnp.where(r_sh_any >= 0, r_sh_any, by_parent_id)
+    by_parent_id = jnp.where(primary_matches, primary, by_parent_id)
+    by_parent_id = jnp.where(r_sh_fine >= 0, r_sh_fine, by_parent_id)
+
+    is_table = sord < n
+    combined = jnp.where(is_table | s_qsh, r_ns_any, by_parent_id)
+    inv = jnp.zeros(2 * n, jnp.int32).at[sord].set(combined)
+    un = jnp.where(inv >= 0, rank_to_idx[jnp.where(inv >= 0, inv, 0)], -1)
+    j_shared = jnp.where(sharedv, un[:n], -1)
+    q = jnp.where(has_parent, un[n:], -1)
+    parent = jnp.where(sharedv, jnp.where(j_shared >= 0, j_shared, q), q)
+    return _finish(x, parent)

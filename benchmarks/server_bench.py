@@ -1,14 +1,22 @@
-"""HTTP-level ingest benchmark: the reference's §3.2 throughput path.
+"""Server-level ingest benchmark: the reference's §3.2 throughput path.
 
 `bench.py` measures the library boundary (bytes -> device sketches);
-this harness measures the whole server: aiohttp request handling, gzip
+this harness measures the whole server: request handling, gzip/format
 sniffing, collector dispatch, then the same fast path — i.e. what a load
-balancer in front of `POST /api/v2/spans` would see. On a one-core host
-the aiohttp event loop, the parser and the PJRT client share the CPU,
-so this is a lower bound on a real ingest node.
+balancer in front of the ingest endpoints would see. On a one-core host
+the event loop, the parser and the PJRT client share the CPU, so this
+is a lower bound on a real ingest node.
+
+Formats (SERVER_BENCH_FORMAT, VERDICT r4 order 7 — the 1M/s single-core
+story rests on proto3, so the server-level number must exist for it):
+
+- ``json``   — POST /api/v2/spans, application/json (the r3 baseline)
+- ``proto3`` — POST /api/v2/spans, application/x-protobuf (native
+               proto3 parse on the fast path)
+- ``grpc``   — zipkin.proto3.SpanService/Report unary calls
 
 Run from the repo root: ``python -m benchmarks.server_bench``
-(SERVER_BENCH_SPANS, SERVER_BENCH_MP_WORKERS envs).
+(SERVER_BENCH_SPANS, SERVER_BENCH_MP_WORKERS, SERVER_BENCH_FORMAT).
 """
 
 from __future__ import annotations
@@ -30,6 +38,7 @@ async def run() -> dict:
 
     total = int(os.environ.get("SERVER_BENCH_SPANS", 2_000_000))
     workers = int(os.environ.get("SERVER_BENCH_MP_WORKERS", 0))
+    fmt = os.environ.get("SERVER_BENCH_FORMAT", "json")
     batch = 65_536
     port = int(os.environ.get("SERVER_BENCH_PORT", 19419))
 
@@ -38,46 +47,82 @@ async def run() -> dict:
         ServerConfig(
             port=port, host="127.0.0.1", storage_type="tpu",
             tpu_fast_ingest=True, tpu_mp_workers=workers,
+            grpc_collector_enabled=(fmt == "grpc"), grpc_port=0,
         ),
         storage=storage,
     )
     await server.start()
 
     spans = lots_of_spans(2 * batch, seed=7, services=40, span_names=120)
+    if fmt == "json":
+        enc = json_v2.encode_span_list
+        content_type = "application/json"
+    else:
+        from zipkin_tpu.model import proto3
+
+        enc = proto3.encode_span_list
+        content_type = "application/x-protobuf"
     payloads = [
-        json_v2.encode_span_list(spans[i : i + batch])
-        for i in range(0, len(spans), batch)
+        enc(spans[i : i + batch]) for i in range(0, len(spans), batch)
     ]
     storage.warm(payloads[0])
     warm = storage.ingest_counters()["spans"]
 
-    url = f"http://127.0.0.1:{port}/api/v2/spans"
     sent = warm
     t0 = time.perf_counter()
-    async with ClientSession(connector=TCPConnector(limit=4)) as sess:
-        i = 0
-        # two requests in flight: the server acks 202 on enqueue, so a
-        # single serial client would measure its own think time
-        pending = set()
-        while sent < total + warm or pending:
-            while sent < total + warm and len(pending) < 2:
-                pending.add(
-                    asyncio.create_task(
-                        sess.post(
-                            url, data=payloads[i % len(payloads)],
-                            headers={"Content-Type": "application/json"},
+    if fmt == "grpc":
+        import grpc.aio
+
+        from zipkin_tpu.server.grpc import METHOD
+
+        gport = server._grpc.port
+        async with grpc.aio.insecure_channel(
+            f"127.0.0.1:{gport}",
+            options=[("grpc.max_send_message_length", 64 << 20)],
+        ) as ch:
+            method = ch.unary_unary(METHOD)
+            i = 0
+            pending = set()
+            while sent < total + warm or pending:
+                while sent < total + warm and len(pending) < 2:
+                    pending.add(
+                        asyncio.ensure_future(
+                            method(payloads[i % len(payloads)])
                         )
                     )
+                    i += 1
+                    sent += batch
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
                 )
-                i += 1
-                sent += batch
-            done, pending = await asyncio.wait(
-                pending, return_when=asyncio.FIRST_COMPLETED
-            )
-            for d in done:
-                resp = d.result()
-                assert resp.status == 202, resp.status
-                resp.release()
+                for d in done:
+                    assert d.result() == b""
+    else:
+        url = f"http://127.0.0.1:{port}/api/v2/spans"
+        async with ClientSession(connector=TCPConnector(limit=4)) as sess:
+            i = 0
+            # two requests in flight: the server acks 202 on enqueue, so
+            # a single serial client would measure its own think time
+            pending = set()
+            while sent < total + warm or pending:
+                while sent < total + warm and len(pending) < 2:
+                    pending.add(
+                        asyncio.create_task(
+                            sess.post(
+                                url, data=payloads[i % len(payloads)],
+                                headers={"Content-Type": content_type},
+                            )
+                        )
+                    )
+                    i += 1
+                    sent += batch
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for d in done:
+                    resp = d.result()
+                    assert resp.status == 202, resp.status
+                    resp.release()
     if server._mp_ingester is not None:
         await asyncio.to_thread(server._mp_ingester.drain)
     storage.agg.block_until_ready()
@@ -85,10 +130,11 @@ async def run() -> dict:
     accepted = storage.ingest_counters()["spans"] - warm
     await server.stop()
     return {
-        "metric": "server_http_ingest_spans_per_sec",
+        "metric": f"server_{fmt}_ingest_spans_per_sec",
         "value": round(accepted / elapsed, 1),
         "unit": "spans/s",
         "spans": accepted,
+        "format": fmt,
         "mp_workers": workers,
         "vs_library_path": "see BENCH artifacts (bench.py json mode)",
     }

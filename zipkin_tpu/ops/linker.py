@@ -98,6 +98,46 @@ def _run_min(values: jnp.ndarray, change: jnp.ndarray, none: int) -> jnp.ndarray
     return jnp.where(out >= none, -1, out)
 
 
+def _run_min_ladder(channel_runs, none: int):
+    """Segmented run-min BROADCAST via a flat shift-doubling ladder:
+    each channel carries its own run identity; every doubling step is
+    one fused elementwise kernel (min over self + left/right neighbor
+    at distance d, guarded by run-id equality) over ALL channels.
+
+    This replaces the associative-scan formulation (r5 chip A/B,
+    benchmarks/resolve_variants.py + PROFILE_r05): the scans' tree
+    sweeps cost ~15 ms of the 23.6 ms resolve at ring 2^18 and resisted
+    every restructuring (channel fusion, reverse=True, forward-only
+    dual-sort all measured flat or worse — XLA already CSEs identical
+    scans); the ladder's ceil(log2 n) fused steps measure 18.9 ms for
+    the whole resolve (-4.7 ms) and 29.6 ms for the full link context
+    (-6.6 ms). ``channel_runs`` = [(values, run_id), ...]."""
+    n = channel_runs[0][0].shape[0]
+    vs = [v for v, _ in channel_runs]
+    rids = [r for _, r in channel_runs]
+    inf = jnp.int32(none)
+    steps = max(int(n - 1).bit_length(), 1)
+    for k in range(steps):
+        d = 1 << k
+        if d >= n:
+            break
+        new = []
+        for v, rid in zip(vs, rids):
+            rid_l = jnp.concatenate(
+                [jnp.full((d,), -1, jnp.int32), rid[:-d]]
+            )
+            rid_r = jnp.concatenate(
+                [rid[d:], jnp.full((d,), -2, jnp.int32)]
+            )
+            lv = jnp.concatenate([jnp.full((d,), inf), v[:-d]])
+            rv = jnp.concatenate([v[d:], jnp.full((d,), inf)])
+            v = jnp.minimum(v, jnp.where(rid == rid_l, lv, inf))
+            v = jnp.minimum(v, jnp.where(rid == rid_r, rv, inf))
+            new.append(v)
+        vs = new
+    return [jnp.where(v >= none, -1, v) for v in vs]
+
+
 def union_key_lanes(x: LinkInput):
     """The four u32 sort-key lanes of the 2n-lane join union (table half
     then query half), invalid lanes keyed 0xFFFFFFFF."""
@@ -224,9 +264,12 @@ def resolve_parents(x: LinkInput) -> Tuple[jnp.ndarray, jnp.ndarray]:
     coarse = _run_starts(list(s_ids))
     fine = coarse | jnp.asarray(segment_starts(s_svc))
 
-    r_sh_fine = _run_min_bcast(sh_s, fine, sent)   # shared, same service
-    r_sh_any = _run_min_bcast(sh_s, coarse, sent)  # any shared
-    r_ns_any = _run_min_bcast(ns_s, coarse, sent)  # first non-shared
+    rid_c = jnp.cumsum(coarse.astype(jnp.int32))
+    rid_f = jnp.cumsum(fine.astype(jnp.int32))
+    # all three run-min broadcasts ride ONE shift-doubling ladder
+    r_sh_any, r_ns_any, r_sh_fine = _run_min_ladder(
+        [(sh_s, rid_c), (ns_s, rid_c), (sh_s, rid_f)], sent
+    )  # any shared / first non-shared / shared with same service
 
     # Parent-id resolution in SpanNode._choose_parent preference order,
     # evaluated PER SORTED LANE: 1) first shared with the child\'s

@@ -302,16 +302,31 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         )
     )
 
-    # dependency edges compacted ON DEVICE: top-E cells of the merged
-    # [S, S] call matrix (an [S^2] top_k), so a query ships 3 small [E]
-    # vectors over the tunnel instead of two dense matrices
+    # dependency edges compacted ON DEVICE: the first E nonzero cells of
+    # the merged [S, S] call matrix via prefix-sum compaction (cumsum +
+    # searchsorted + gather), so a query ships 3 small [E] vectors over
+    # the tunnel instead of two dense matrices. Equivalent to the r4
+    # top-E-by-calls: both exist to ship EVERY nonzero edge when they
+    # fit in E — and when they don't, every returned slot is live, which
+    # is exactly the host's dense-fallback trigger (store.py). The
+    # compaction measured 0.88 ms vs top_k's 1.09 at [1024^2] (r5 A/B).
     num_edges = min(4096, config.max_services * config.max_services)
 
     def _edge_topk(calls, errors):
-        calls = jax.lax.psum(calls, SHARD_AXIS).reshape(-1)
-        errors = jax.lax.psum(errors, SHARD_AXIS).reshape(-1)
-        top, idx = jax.lax.top_k(calls, num_edges)
-        return idx, top, errors[idx]
+        cf = jax.lax.psum(calls, SHARD_AXIS).reshape(-1)
+        ef = jax.lax.psum(errors, SHARD_AXIS).reshape(-1)
+        nz = (cf > 0).astype(jnp.int32)
+        cs = jnp.cumsum(nz)
+        pos = jnp.searchsorted(
+            cs, jnp.arange(1, num_edges + 1, dtype=jnp.int32), side="left"
+        )
+        pos = jnp.clip(pos, 0, cf.shape[0] - 1)
+        have = jnp.arange(num_edges) < cs[-1]
+        return (
+            jnp.where(have, pos, 0).astype(jnp.int32),
+            jnp.where(have, cf[pos], 0),
+            jnp.where(have, ef[pos], 0),
+        )
 
     def spmd_edges(ctx, state: AggState, ts_lo, ts_hi):
         s = jax.tree_util.tree_map(lambda a: a[0], state)

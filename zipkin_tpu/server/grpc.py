@@ -68,7 +68,12 @@ class GrpcCollectorServer:
         self.port = port
 
     async def start(self) -> "GrpcCollectorServer":
-        server = grpc.aio.server()
+        # span batches are big by design (a 64k-span ListOfSpans is
+        # ~5 MB); grpc's 4 MB default would RESOURCE_EXHAUSTED them
+        server = grpc.aio.server(options=[
+            ("grpc.max_receive_message_length", 64 << 20),
+            ("grpc.max_send_message_length", 64 << 20),
+        ])
         server.add_generic_rpc_handlers((_SpanServiceHandler(self._collector),))
         self.port = server.add_insecure_port(self._address)
         await server.start()
