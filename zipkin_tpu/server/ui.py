@@ -135,16 +135,56 @@ async function loadPctCtx(){
       {p50:x.quantiles['0.5'],p99:x.quantiles['0.99']};
   }catch(e){/* TPU sketches not enabled: waterfall renders without context */}
 }
+function treeOrder(spans){
+  // Lens-style waterfall order: DFS over the span tree (parentId
+  // edges; a shared SERVER span nests under its same-id client half),
+  // children by timestamp; orphans (missing parents) surface as roots.
+  // Returns [[span, depth], ...]. Cycle-safe via the visited set.
+  const byId=new Map();
+  for(const s of spans){const k=s.id;
+    if(!byId.has(k))byId.set(k,[]);byId.get(k).push(s)}
+  const parentOf=s=>{
+    if(s.shared){  // server half: parent is the client half (same id)
+      const mates=(byId.get(s.id)||[]).filter(m=>m!==s&&!m.shared);
+      if(mates.length)return mates[0];
+    }
+    if(s.parentId&&byId.has(s.parentId)){
+      const c=byId.get(s.parentId);
+      return c.find(m=>!m.shared)||c[0];
+    }
+    return null;
+  };
+  const kids=new Map(),roots=[];
+  for(const s of spans){const p=parentOf(s);
+    if(p){if(!kids.has(p))kids.set(p,[]);kids.get(p).push(s)}
+    else roots.push(s)}
+  const ts=s=>s.timestamp||1e18;
+  roots.sort((a,b)=>ts(a)-ts(b));
+  const out=[],seen=new Set();
+  const walk=(s,d)=>{
+    if(seen.has(s))return;seen.add(s);
+    out.push([s,d]);
+    const c=(kids.get(s)||[]).sort((a,b)=>ts(a)-ts(b));
+    for(const k of c)walk(k,d+1);
+  };
+  for(const r of roots)walk(r,0);
+  for(const s of spans)if(!seen.has(s))out.push([s,0]); // cycle leftovers
+  return out;
+}
 async function detail(id){
   const spans=await get('/api/v2/trace/'+id);
   await loadPctCtx();
-  curSpans=spans.sort((a,b)=>(a.timestamp||0)-(b.timestamp||0));
+  const ordered=treeOrder(spans);
+  curSpans=ordered.map(([s,_])=>s);
   const t0=Math.min(...spans.map(s=>s.timestamp||1e18));
   const total=Math.max(...spans.map(s=>(s.timestamp||t0)+(s.duration||0)))-t0||1;
+  const svcs=new Set(spans.map(s=>(s.localEndpoint||{}).serviceName).filter(Boolean));
   const el=$('#detail');
-  let h=`<h2>trace ${esc(hexOnly(id))} <span class="muted">(click a span for detail)</span></h2>
+  let h=`<h2>trace ${esc(hexOnly(id))}
+    <span class="muted">${spans.length} spans · ${svcs.size} services ·
+    ${Math.round(total)} µs (click a span for detail)</span></h2>
     <table><tr><th>service</th><th>span</th><th>timeline</th><th>µs</th><th>vs p99</th></tr>`;
-  curSpans.forEach((s,i)=>{
+  ordered.forEach(([s,depth],i)=>{
     const off=100*((s.timestamp||t0)-t0)/total, w=Math.max(100*(s.duration||0)/total,0.5);
     const err=s.tags&&s.tags.error!==undefined;
     const key=((s.localEndpoint||{}).serviceName||'')+'|'+(s.name||'');
@@ -157,9 +197,11 @@ async function detail(id){
       vs=r>=1?`<span class="slow">${r.toFixed(1)}x p99</span>`
              :s.duration>=ctx.p50?'&gt;p50':'&lt;p50';
     }
+    const pad=Math.min(depth,12)*14;
+    const mark=depth?'<span class="muted">└ </span>':'';
     h+=`<tr class="srow ${err?'err':''}" onclick="spanDetail(${i})">
-      <td>${esc((s.localEndpoint||{}).serviceName||'')}</td>
-      <td>${esc(s.name||'')} ${esc(s.kind||'')}</td>
+      <td style="padding-left:${6+pad}px">${mark}${esc((s.localEndpoint||{}).serviceName||'')}</td>
+      <td>${esc(s.name||'')} ${esc(s.kind||'')}${s.shared?' <span class="muted">shared</span>':''}</td>
       <td style="width:45%"><div class="bar ${err?'err':''}" style="margin-left:${off}%;width:${w}%"></div></td>
       <td>${esc(s.duration||'')}</td><td>${vs}</td></tr>`;
   });

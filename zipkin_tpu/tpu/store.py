@@ -665,8 +665,26 @@ class TpuStorage(
                 min_dur=request.min_duration, max_dur=request.max_duration,
                 limit=cand_limit, views=views,
             )
-            by_key: dict = {}
+            # RAM-archive union first (object-path spans of the same
+            # traces plus traces only it holds) — cheap, no disk IO
+            ram: dict = {}
+            for trace in self._archive.get_traces_query(request).execute():
+                key = trace_id_key(trace[0].trace_id, self.strict_trace_id)
+                ram.setdefault(key, []).extend(trace)
+            # INCREMENTAL candidate processing (r5, VERDICT r4 order 6's
+            # other half): candidates arrive newest-first, so fetching
+            # + decoding stops once `limit` traces PASS the exact
+            # predicate — a broad query (e.g. service-only) decodes
+            # ~limit traces, not the whole cand_limit over-fetch. The
+            # bounded-scan trade is unchanged: a trace whose candidate
+            # ts is older than the collected set but whose max span ts
+            # is newer can still be missed, exactly as when cand_limit
+            # bounded the scan.
+            out = []
+            seen_keys: set = set()
             for id64, _ in cands:
+                if len(out) >= request.limit:
+                    break
                 raw = self._disk.fetch_trace_raw(
                     id64 & 0xFFFFFFFF, id64 >> 32, 0, 0, strict=False,
                     views=views,
@@ -681,14 +699,16 @@ class TpuStorage(
                     key = trace_id_key(
                         group[0].trace_id, self.strict_trace_id
                     )
-                    by_key.setdefault(key, []).extend(group)
-            # union with the RAM archive (object-path spans of the same
-            # traces plus traces only it holds), then exact predicate
-            for trace in self._archive.get_traces_query(request).execute():
-                key = trace_id_key(trace[0].trace_id, self.strict_trace_id)
-                by_key.setdefault(key, []).extend(trace)
-            out = []
-            for spans in by_key.values():
+                    if key in seen_keys:
+                        continue
+                    seen_keys.add(key)
+                    merged = merge_trace(group + ram.pop(key, []))
+                    if request.test(merged):
+                        out.append(merged)
+            # RAM-only traces the disk walk never touched
+            for key, spans in ram.items():
+                if key in seen_keys:
+                    continue
                 merged = merge_trace(spans)
                 if request.test(merged):
                     out.append(merged)
