@@ -612,12 +612,24 @@ def config4() -> bool:
 
     warm = sent  # spans ingested before the timed window opened
     probe_every = int(os.environ.get("EVAL_PROBE_EVERY", 64))
+    # graceful wall deadline (seconds, 0 = none): the tunneled relay
+    # has hour-scale degraded windows (20-40k spans/s observed r5 where
+    # clean windows run 300-500k/s); without a deadline a bad window
+    # turns the flagship run into an artifact-less stall. On expiry the
+    # stream STOPS CLEANLY and every gate evaluates at the scale
+    # actually reached — reported beside the target, never silently.
+    deadline_s = float(os.environ.get("EVAL_WALL_DEADLINE_S", 0) or 0)
+    progress_every = int(os.environ.get("EVAL_PROGRESS_EVERY", 128))
+    deadline_hit = False
     probes: list = []
     probes_incomplete = 0
     acked: list = []  # patched probe tids, oldest first (bounded)
     distinct_traces = 0
     start = time.perf_counter()
     while sent < total:
+        if deadline_s and time.perf_counter() - start > deadline_s:
+            deadline_hit = True
+            break
         if fast:
             payload, tid = patched(batches)
             n, _ = store.ingest_json_fast(payload)
@@ -651,6 +663,14 @@ def config4() -> bool:
             # on device (ms under the lock), pulls lock-free, truncates
             # WAL segments the snapshot covers — disk stays bounded
             store.snapshot()
+        if progress_every and batches % progress_every == 0:
+            print(json.dumps({
+                "progress": sent,
+                "of": total,
+                "spans_per_sec": round(
+                    (sent - warm) / (time.perf_counter() - start)
+                ),
+            }), file=sys.stderr, flush=True)
     store.agg.block_until_ready()
     if not lat["dependencies"]:
         query_round(lat)  # never skip the query half at small smoke scales
@@ -888,6 +908,7 @@ def config4() -> bool:
             k: v for k, v in counters.items() if k.startswith("archive")
         }
     _emit(config="config4", passed=bool(ok and slo_ok), spans=sent,
+          target_spans=total, wall_deadline_hit=deadline_hit,
           fast_path=fast,
           sustained_spans_per_sec=round((sent - warm) / elapsed),
           distinct_identity_gate=hll_gate,

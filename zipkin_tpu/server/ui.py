@@ -149,8 +149,11 @@ function treeOrder(spans){
       if(mates.length)return mates[0];
     }
     if(s.parentId&&byId.has(s.parentId)){
+      // prefer the SHARED rendition (the server half is the closer
+      // tree node — SpanNode's index preference), so server-created
+      // children nest under the server span, not beside it
       const c=byId.get(s.parentId);
-      return c.find(m=>!m.shared)||c[0];
+      return c.find(m=>m.shared)||c[0];
     }
     return null;
   };

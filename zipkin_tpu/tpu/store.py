@@ -705,11 +705,17 @@ class TpuStorage(
                     merged = merge_trace(group + ram.pop(key, []))
                     if request.test(merged):
                         out.append(merged)
-            # RAM-only traces the disk walk never touched
+            # Traces the disk walk never touched but the RAM archive
+            # matched: their spans may ALSO exist on disk (an early
+            # break above skips candidates once `limit` passed), so
+            # fetch the disk half by trace id before merging — a
+            # returned trace is always complete, never RAM-only
+            # (r5 review finding). Bounded: the RAM query returns at
+            # most `limit` traces.
             for key, spans in ram.items():
-                if key in seen_keys:
-                    continue
-                merged = merge_trace(spans)
+                merged = merge_trace(
+                    spans + self._disk_trace_spans(spans[0].trace_id)
+                )
                 if request.test(merged):
                     out.append(merged)
             out.sort(
