@@ -360,17 +360,56 @@ class TestThrottle:
 
 
 class TestUiPage:
-    def test_ui_page_serves_span_detail_panel(self):
+    def test_ui_index_loads_app_bundle(self):
         async def scenario(client):
             resp = await client.get("/zipkin/")
             assert resp.status == 200
             page = await resp.text()
-            # r3 additions: span-detail panel + percentile context in
-            # the waterfall + red error bars
             for marker in (
-                'id="spanpanel"', "spanDetail(", "vs p99",
-                ".bar.err", "loadPctCtx", 'id="depgraph"', "depGraph(",
+                'id="spanpanel"', 'id="view"', "/zipkin/static/app.js",
+                "/zipkin/static/style.css", 'data-nav="dependencies"',
+                'data-nav="sketches"',
             ):
                 assert marker in page, marker
+
+        run(scenario)
+
+    def test_ui_app_js_has_all_views(self):
+        async def scenario(client):
+            resp = await client.get("/zipkin/static/app.js")
+            assert resp.status == 200
+            assert "javascript" in resp.headers["Content-Type"]
+            js = await resp.text()
+            # the r3/r4 feature set survives the SPA split: span-detail
+            # panel + percentile context + dep graph + tree nesting,
+            # plus the r5 views (collapse, minimap, service detail,
+            # sketches panel)
+            for marker in (
+                "spanDetail(", "vs p99", "loadPctCtx", "depGraph(",
+                "treeOrder(", "VIEWS.set('discover'", "VIEWS.set('trace'",
+                "VIEWS.set('dependencies'", "VIEWS.set('sketches'",
+                "drawMinimap(", "subtreeEnd(", "serviceDetail(",
+            ):
+                assert marker in js, marker
+
+        run(scenario)
+
+    def test_ui_style_css_served(self):
+        async def scenario(client):
+            resp = await client.get("/zipkin/static/style.css")
+            assert resp.status == 200
+            assert "css" in resp.headers["Content-Type"]
+            css = await resp.text()
+            assert ".bar.err" in css and "#spanpanel" in css
+
+        run(scenario)
+
+    def test_ui_asset_allowlist_blocks_traversal(self):
+        async def scenario(client):
+            # the asset route resolves names through a fixed allowlist,
+            # never the filesystem — traversal shapes must 404
+            for name in ("ui.py", "..%2Fui.py", "nope.js"):
+                resp = await client.get(f"/zipkin/static/{name}")
+                assert resp.status == 404, name
 
         run(scenario)

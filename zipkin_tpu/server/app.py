@@ -204,12 +204,22 @@ class ZipkinServer:
         r.add_get("/config.json", self.get_ui_config)
         r.add_get("/zipkin/", self.get_ui)
         r.add_get("/zipkin", self.get_ui)
+        r.add_get("/zipkin/static/{name}", self.get_ui_asset)
         return app
 
     async def get_ui(self, request: web.Request) -> web.Response:
-        from zipkin_tpu.server.ui import PAGE
+        from zipkin_tpu.server.ui import index_page
 
-        return web.Response(text=PAGE, content_type="text/html")
+        return web.Response(text=index_page(), content_type="text/html")
+
+    async def get_ui_asset(self, request: web.Request) -> web.Response:
+        from zipkin_tpu.server.ui import asset
+
+        found = asset(request.match_info["name"])
+        if found is None:
+            return web.Response(status=404, text="no such asset")
+        body, ctype = found
+        return web.Response(body=body, content_type=ctype)
 
     async def start(self) -> "ZipkinServer":
         app = self.make_app()

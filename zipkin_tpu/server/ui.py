@@ -1,319 +1,52 @@
-"""Minimal built-in UI served at /zipkin/.
+"""Built-in UI served at /zipkin/ — a hash-routed single-page app.
 
 The reference serves the Lens React bundle from the server jar
-(SURVEY.md §2.5); the rebuild keeps **API-shape compatibility** so Lens
-itself can be pointed at this server, and ships this small dependency-free
-page for the same three views (search, trace detail with a span-detail
-panel and sketch-served duration-percentile context, dependencies) plus
-the TPU percentile extension — consuming only the public JSON API.
+(SURVEY.md §2.5: zipkin-lens, ~20k LoC TS/React, consuming only the L4
+JSON API). The rebuild keeps **API-shape compatibility** (pinned by
+tests/test_lens_conformance.py) so Lens itself can be pointed at this
+server, and ships this dependency-free app for the same views:
+
+- Discover: service/spanName/annotationQuery/duration search with
+  shareable URLs, per-trace service-share duration bars.
+- Trace detail: Lens-style waterfall (shared-span nesting, DFS order),
+  collapsible subtrees, minimap, timeline ruler, span-detail panel with
+  sketch-served duration-percentile context.
+- Dependencies: animated-graph equivalent (SVG call graph) + per-service
+  callers/callees panel, fed solely by GET /api/v2/dependencies.
+- TPU sketches: the rebuild's extension views (device percentiles,
+  HLL cardinalities, ingest counters, snapshot trigger).
+
+Assets are plain files under static/ (no build step — the deploy box
+cannot run npm, and a 3-file vanilla app keeps the attack surface
+reviewable: every payload-derived string is escaped, see app.js header).
 """
 
-PAGE = """<!doctype html>
-<html><head><meta charset="utf-8"><title>zipkin-tpu</title>
-<style>
- body{font-family:system-ui,sans-serif;margin:0;background:#fafafa;color:#222}
- header{background:#1a237e;color:#fff;padding:10px 16px;display:flex;gap:16px;align-items:center}
- header h1{font-size:16px;margin:0}
- main{padding:16px;max-width:1100px;margin:auto}
- section{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px;margin-bottom:16px}
- h2{font-size:14px;margin:0 0 8px}
- table{border-collapse:collapse;width:100%;font-size:13px}
- td,th{border-bottom:1px solid #eee;padding:4px 6px;text-align:left}
- .bar{background:#3f51b5;height:10px;border-radius:2px}
- .bar.err{background:#b71c1c}
- .err{color:#b71c1c}
- .slow{color:#e65100;font-weight:600}
- select,input,button{font-size:13px;padding:3px 6px}
- .muted{color:#777}
- tr.srow{cursor:pointer}
- tr.srow:hover{background:#f0f2ff}
- #spanpanel{position:fixed;right:0;top:0;bottom:0;width:360px;background:#fff;
-  border-left:2px solid #1a237e;padding:12px;overflow:auto;box-shadow:-2px 0 8px #0002;display:none}
- #spanpanel h3{margin:0 0 8px;font-size:14px}
- #spanpanel table{font-size:12px}
- #spanpanel .close{float:right}
-</style></head><body>
-<header><h1>zipkin-tpu</h1><span id="info" class="muted"></span></header>
-<main>
-<section><h2>Find traces</h2>
- <select id="svc" onchange="loadNames()"><option value="">all services</option></select>
- <select id="spanname"><option value="">all spans</option></select>
- <input id="annq" placeholder="annotationQuery: error and http.method=GET" style="width:22em">
- <input id="mindur" type="number" placeholder="min µs" style="width:6em">
- <input id="maxdur" type="number" placeholder="max µs" style="width:6em">
- <select id="lookback">
-  <option value="3600000">last hour</option>
-  <option value="86400000">last day</option>
-  <option value="604800000" selected>last 7 days</option>
- </select>
- <input id="limit" type="number" value="10" style="width:4em">
- <button onclick="findTraces()">search</button>
- <span style="margin-left:12px">trace id:
-  <input id="tid" placeholder="hex trace id" style="width:18em">
-  <button onclick="gotoTrace()">open</button></span>
- <div id="traces"></div>
- <div id="detail"></div>
-</section>
-<section><h2>Dependencies</h2><button onclick="deps()">refresh</button>
- <svg id="depgraph" width="100%" height="0" viewBox="0 0 800 500"></svg>
- <table id="deptab"><tr><th>parent</th><th>child</th><th>calls</th><th>errors</th></tr></table>
-</section>
-<section><h2>Latency percentiles (TPU sketches)</h2><button onclick="pcts()">refresh</button>
- <table id="pcttab"><tr><th>service</th><th>span</th><th>count</th><th>p50 µs</th><th>p99 µs</th></tr></table>
-</section>
-</main>
-<div id="spanpanel"></div>
-<script>
-const $=q=>document.querySelector(q);
-const get=async p=>{const r=await fetch(p);if(!r.ok)throw new Error(p+': '+r.status);return r.json()};
-// span fields are attacker-controlled (anyone can POST to the collector):
-// everything interpolated into markup goes through esc(), and trace ids
-// are validated as hex before being used in an onclick.
-const esc=s=>String(s??'').replace(/[&<>"'`]/g,c=>'&#'+c.charCodeAt(0)+';');
-const hexOnly=s=>/^[0-9a-f]{1,32}$/.test(s)?s:'';
-async function boot(){
-  try{const i=await get('/info');$('#info').textContent='v'+i.zipkin.version;}catch(e){}
-  try{const s=await get('/api/v2/services');
-    for(const n of s){const o=document.createElement('option');o.value=o.textContent=n;$('#svc').append(o)}}catch(e){}
-}
-async function loadNames(){
-  // per-service span names for the spanName filter (the Lens discover
-  // page's second dropdown)
-  const svc=$('#svc').value, sel=$('#spanname');
-  sel.innerHTML='<option value="">all spans</option>';
-  if(!svc)return;
-  try{const names=await get('/api/v2/spans?serviceName='+encodeURIComponent(svc));
-    for(const n of names){const o=document.createElement('option');o.value=o.textContent=n;sel.append(o)}
-  }catch(e){}
-}
-function gotoTrace(){
-  const raw=$('#tid').value.trim().toLowerCase();
-  const id=hexOnly(raw);
-  const el=$('#detail');
-  if(!id){el.innerHTML='<p class="err">not a hex trace id</p>';return}
-  detail(id).catch(e=>{el.innerHTML='<p class="err">trace not found: '+esc(id)+'</p>'});
-}
-async function findTraces(){
-  const svc=$('#svc').value, lim=$('#limit').value||10;
-  const elq=$('#traces');
-  const q=new URLSearchParams({endTs:Date.now(),
-    lookback:$('#lookback').value||7*864e5,limit:lim});
-  if(svc)q.set('serviceName',svc);
-  const name=$('#spanname').value; if(name)q.set('spanName',name);
-  const annq=$('#annq').value.trim(); if(annq)q.set('annotationQuery',annq);
-  const mind=$('#mindur').value; if(mind)q.set('minDuration',mind);
-  const maxd=$('#maxdur').value; if(maxd)q.set('maxDuration',maxd);
-  let traces;
-  try{traces=await get('/api/v2/traces?'+q)}
-  catch(e){elq.innerHTML='<p class="err">search failed: '+esc(e.message)+
-    ' (check the filter values)</p>';return}
-  const el=elq;el.innerHTML='';
-  if(!traces.length){el.innerHTML='<p class="muted">no traces matched</p>';return}
-  const t=document.createElement('table');
-  t.innerHTML='<tr><th>start</th><th>trace</th><th>services</th><th>spans</th><th>duration µs</th><th></th></tr>';
-  for(const tr of traces){
-    const root=tr.reduce((a,b)=>(a.timestamp||1e18)<(b.timestamp||1e18)?a:b);
-    const id=hexOnly(root.traceId);
-    const svcs=[...new Set(tr.map(s=>(s.localEndpoint||{}).serviceName).filter(Boolean))];
-    const when=root.timestamp?new Date(root.timestamp/1000).toISOString().slice(0,19):'';
-    const anyErr=tr.some(s=>s.tags&&s.tags.error!==undefined);
-    const row=document.createElement('tr');
-    row.innerHTML=`<td>${esc(when)}</td><td class="${anyErr?'err':''}">${esc(id)}</td>
-      <td>${esc(svcs.slice(0,4).join(', '))}${svcs.length>4?' …':''}</td>
-      <td>${tr.length}</td><td>${esc(root.duration||'')}</td>
-      <td><button onclick="detail('${id}')">view</button></td>`;
-    t.append(row);
-  }
-  el.append(t);
-}
-let curSpans=[];   // spans of the open trace, for the detail panel
-let pctCtx={};     // (service|span) -> {p50, p99} percentile context
-async function loadPctCtx(){
-  if(Object.keys(pctCtx).length)return;
-  try{const rows=await get('/api/v2/tpu/percentiles?q=0.5,0.99');
-    for(const x of rows)pctCtx[x.serviceName+'|'+x.spanName]=
-      {p50:x.quantiles['0.5'],p99:x.quantiles['0.99']};
-  }catch(e){/* TPU sketches not enabled: waterfall renders without context */}
-}
-function treeOrder(spans){
-  // Lens-style waterfall order: DFS over the span tree (parentId
-  // edges; a shared SERVER span nests under its same-id client half),
-  // children by timestamp; orphans (missing parents) surface as roots.
-  // Returns [[span, depth], ...]. Cycle-safe via the visited set.
-  const byId=new Map();
-  for(const s of spans){const k=s.id;
-    if(!byId.has(k))byId.set(k,[]);byId.get(k).push(s)}
-  const parentOf=s=>{
-    if(s.shared){  // server half: parent is the client half (same id)
-      const mates=(byId.get(s.id)||[]).filter(m=>m!==s&&!m.shared);
-      if(mates.length)return mates[0];
-    }
-    if(s.parentId&&byId.has(s.parentId)){
-      // prefer the SHARED rendition (the server half is the closer
-      // tree node — SpanNode's index preference), so server-created
-      // children nest under the server span, not beside it
-      const c=byId.get(s.parentId);
-      return c.find(m=>m.shared)||c[0];
-    }
-    return null;
-  };
-  const kids=new Map(),roots=[];
-  for(const s of spans){const p=parentOf(s);
-    if(p){if(!kids.has(p))kids.set(p,[]);kids.get(p).push(s)}
-    else roots.push(s)}
-  const ts=s=>s.timestamp||1e18;
-  roots.sort((a,b)=>ts(a)-ts(b));
-  const out=[],seen=new Set();
-  const walk=(s,d)=>{
-    if(seen.has(s))return;seen.add(s);
-    out.push([s,d]);
-    const c=(kids.get(s)||[]).sort((a,b)=>ts(a)-ts(b));
-    for(const k of c)walk(k,d+1);
-  };
-  for(const r of roots)walk(r,0);
-  for(const s of spans)if(!seen.has(s))out.push([s,0]); // cycle leftovers
-  return out;
-}
-async function detail(id){
-  const spans=await get('/api/v2/trace/'+id);
-  await loadPctCtx();
-  const ordered=treeOrder(spans);
-  curSpans=ordered.map(([s,_])=>s);
-  const t0=Math.min(...spans.map(s=>s.timestamp||1e18));
-  const total=Math.max(...spans.map(s=>(s.timestamp||t0)+(s.duration||0)))-t0||1;
-  const svcs=new Set(spans.map(s=>(s.localEndpoint||{}).serviceName).filter(Boolean));
-  const el=$('#detail');
-  let h=`<h2>trace ${esc(hexOnly(id))}
-    <span class="muted">${spans.length} spans · ${svcs.size} services ·
-    ${Math.round(total)} µs (click a span for detail)</span></h2>
-    <table><tr><th>service</th><th>span</th><th>timeline</th><th>µs</th><th>vs p99</th></tr>`;
-  ordered.forEach(([s,depth],i)=>{
-    const off=100*((s.timestamp||t0)-t0)/total, w=Math.max(100*(s.duration||0)/total,0.5);
-    const err=s.tags&&s.tags.error!==undefined;
-    const key=((s.localEndpoint||{}).serviceName||'')+'|'+(s.name||'');
-    const ctx=pctCtx[key];
-    // duration-percentile context from the device sketches (the Lens
-    // "how slow is this span vs its peers" panel)
-    let vs='';
-    if(ctx&&s.duration){
-      const r=s.duration/ctx.p99;
-      vs=r>=1?`<span class="slow">${r.toFixed(1)}x p99</span>`
-             :s.duration>=ctx.p50?'&gt;p50':'&lt;p50';
-    }
-    const pad=Math.min(depth,12)*14;
-    const mark=depth?'<span class="muted">└ </span>':'';
-    h+=`<tr class="srow ${err?'err':''}" onclick="spanDetail(${i})">
-      <td style="padding-left:${6+pad}px">${mark}${esc((s.localEndpoint||{}).serviceName||'')}</td>
-      <td>${esc(s.name||'')} ${esc(s.kind||'')}${s.shared?' <span class="muted">shared</span>':''}</td>
-      <td style="width:45%"><div class="bar ${err?'err':''}" style="margin-left:${off}%;width:${w}%"></div></td>
-      <td>${esc(s.duration||'')}</td><td>${vs}</td></tr>`;
-  });
-  el.innerHTML=h+'</table>';
-}
-function spanDetail(i){
-  const s=curSpans[i];if(!s)return;
-  const row=(k,v)=>v===undefined||v===''?'':`<tr><th>${esc(k)}</th><td>${esc(v)}</td></tr>`;
-  const ep=e=>e?[e.serviceName,e.ipv4||e.ipv6,e.port].filter(Boolean).join(' '):'';
-  let h=`<button class="close" onclick="$('#spanpanel').style.display='none'">×</button>
-    <h3>${esc(s.name||'(unnamed)')} <span class="muted">${esc(s.kind||'')}</span></h3><table>`;
-  h+=row('traceId',s.traceId)+row('spanId',s.id)+row('parentId',s.parentId)
-    +row('shared',s.shared?'true':'')+row('timestamp µs',s.timestamp)
-    +row('duration µs',s.duration)
-    +row('local',ep(s.localEndpoint))+row('remote',ep(s.remoteEndpoint));
-  const key=((s.localEndpoint||{}).serviceName||'')+'|'+(s.name||'');
-  const ctx=pctCtx[key];
-  if(ctx)h+=row('peer p50 µs',Math.round(ctx.p50))+row('peer p99 µs',Math.round(ctx.p99));
-  h+='</table>';
-  if(s.annotations&&s.annotations.length){
-    h+='<h3>annotations</h3><table>';
-    for(const a of s.annotations)h+=row(a.timestamp,a.value);
-    h+='</table>';
-  }
-  const tags=s.tags||{};
-  if(Object.keys(tags).length){
-    h+='<h3>tags</h3><table>';
-    for(const k of Object.keys(tags).sort())
-      h+=`<tr><th class="${k==='error'?'err':''}">${esc(k)}</th><td>${esc(tags[k])}</td></tr>`;
-    h+='</table>';
-  }
-  const p=$('#spanpanel');p.innerHTML=h;p.style.display='block';
-}
-async function deps(){
-  const links=await get('/api/v2/dependencies?endTs='+Date.now()+'&lookback='+7*864e5);
-  const t=$('#deptab');t.innerHTML='<tr><th>parent</th><th>child</th><th>calls</th><th>errors</th></tr>';
-  for(const l of links){const r=document.createElement('tr');
-    r.innerHTML=`<td>${esc(l.parent)}</td><td>${esc(l.child)}</td><td>${esc(l.callCount)}</td>
-      <td class="${l.errorCount?'err':''}">${esc(l.errorCount||0)}</td>`;t.append(r)}
-  depGraph(links);
-}
-function depGraph(links){
-  // service graph (the Lens dependencies view): nodes on a circle,
-  // directed edges with width ~ log(calls), red when errors flow.
-  // Built with createElementNS + textContent only — span/service names
-  // are attacker-controlled and never touch innerHTML here.
-  const svg=$('#depgraph');const NS='http://www.w3.org/2000/svg';
-  svg.innerHTML='';
-  // rank services by call volume so a >48-service graph keeps the
-  // heavy hitters, and SAY what was dropped (a silently truncated
-  // graph reads as "those call paths do not exist"). Maps, not plain
-  // objects: service names are attacker-controlled and "__proto__" /
-  // "constructor" would corrupt object-keyed lookups.
-  const vol=new Map();
-  for(const l of links){vol.set(l.parent,(vol.get(l.parent)||0)+(l.callCount||0));
-    vol.set(l.child,(vol.get(l.child)||0)+(l.callCount||0))}
-  const all=[...vol.keys()].sort((a,b)=>vol.get(b)-vol.get(a));
-  const names=all.slice(0,48);
-  if(!names.length){svg.setAttribute('height','0');return}
-  svg.setAttribute('height','500');
-  const cx=400,cy=250,R=Math.min(200,60+names.length*8);
-  const pos=new Map();
-  names.forEach((n,i)=>{const a=2*Math.PI*i/names.length-Math.PI/2;
-    pos.set(n,[cx+R*Math.cos(a),cy+R*Math.sin(a)])});
-  const el=(k,at)=>{const e=document.createElementNS(NS,k);
-    for(const[a,v]of Object.entries(at))e.setAttribute(a,v);return e};
-  // reduce, not Math.max(...spread): a 100k-link response would blow
-  // the JS argument-count limit
-  const maxC=links.reduce((m,l)=>Math.max(m,l.callCount||1),1);
-  for(const l of links){
-    const p=pos.get(l.parent),c=pos.get(l.child);if(!p||!c)continue;
-    const w=0.8+3*Math.log(1+(l.callCount||1))/Math.log(1+maxC);
-    // curve through a point pulled toward the center so opposite-direction
-    // edges between the same pair stay distinguishable
-    const mx=(p[0]+c[0])/2+(cy-(p[1]+c[1])/2)*0.25,
-          my=(p[1]+c[1])/2+((p[0]+c[0])/2-cx)*0.25;
-    const path=el('path',{d:`M${p[0]},${p[1]} Q${mx},${my} ${c[0]},${c[1]}`,
-      fill:'none',stroke:l.errorCount?'#b71c1c':'#7986cb','stroke-width':w,opacity:0.75});
-    const tip=document.createElementNS(NS,'title');
-    tip.textContent=`${l.parent} -> ${l.child}: ${l.callCount} calls, ${l.errorCount||0} errors`;
-    path.append(tip);svg.append(path);
-    // direction tick at 70% along the curve
-    const tx=0.09*p[0]+0.42*mx+0.49*c[0],ty=0.09*p[1]+0.42*my+0.49*c[1];
-    svg.append(el('circle',{cx:tx,cy:ty,r:Math.max(w,1.6),
-      fill:l.errorCount?'#b71c1c':'#3f51b5'}));
-  }
-  for(const n of names){
-    const[x,y]=pos.get(n);
-    svg.append(el('circle',{cx:x,cy:y,r:5,fill:'#1a237e'}));
-    const label=el('text',{x:x+(x>=cx?8:-8),y:y+4,'font-size':'11',
-      'text-anchor':x>=cx?'start':'end',fill:'#222'});
-    label.textContent=n;  // textContent: no markup interpretation
-    svg.append(label);
-  }
-  if(all.length>names.length){
-    const note=el('text',{x:10,y:20,'font-size':'12',fill:'#b71c1c'});
-    note.textContent=`${all.length-names.length} lower-volume services not shown (full list in the table below)`;
-    svg.append(note);
-  }
-}
-async function pcts(){
-  try{
-    const rows=await get('/api/v2/tpu/percentiles?q=0.5,0.99');
-    const t=$('#pcttab');t.innerHTML='<tr><th>service</th><th>span</th><th>count</th><th>p50 µs</th><th>p99 µs</th></tr>';
-    for(const x of rows){const r=document.createElement('tr');
-      r.innerHTML=`<td>${esc(x.serviceName)}</td><td>${esc(x.spanName)}</td><td>${esc(x.count)}</td>
-        <td>${Math.round(x.quantiles['0.5'])}</td><td>${Math.round(x.quantiles['0.99'])}</td>`;t.append(r)}
-  }catch(e){$('#pcttab').innerHTML='<tr><td class="muted">TPU storage not enabled</td></tr>'}
-}
-boot();
-</script></body></html>
-"""
+import mimetypes
+import os
+from typing import Optional
+
+STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+_ASSETS = ("index.html", "app.js", "style.css")
+_cache: dict = {}
+
+
+def asset(name: str) -> Optional[tuple]:
+    """(bytes, content_type) for a bundled asset, or None.
+
+    Only names in the fixed allowlist resolve — the request path never
+    touches the filesystem, so traversal is structurally impossible.
+    """
+    if name not in _ASSETS:
+        return None
+    if name not in _cache:
+        with open(os.path.join(STATIC_DIR, name), "rb") as f:
+            body = f.read()
+        ctype = mimetypes.guess_type(name)[0] or "application/octet-stream"
+        _cache[name] = (body, ctype)
+    return _cache[name]
+
+
+def index_page() -> str:
+    body, _ = asset("index.html")
+    return body.decode("utf-8")

@@ -552,10 +552,13 @@ class TpuStorage(
 
     # -- raw trace reads: disk archive + host archive ---------------------
 
-    def _disk_trace_spans(self, trace_id: str) -> List[Span]:
+    def _disk_trace_spans(self, trace_id: str, views=None) -> List[Span]:
         """Decode every archived span matching ``trace_id`` under the
         store's strictness (exact low-64 match; high lanes + the decoded
-        id string verified when strict)."""
+        id string verified when strict). Pass ``views`` (an archive
+        ``views()`` snapshot) when calling in a loop — without it every
+        call re-sorts the live segment (the 1881-argsort search the
+        views() docstring records)."""
         from zipkin_tpu.internal.hex import normalize_trace_id
         from zipkin_tpu.model import json_v2
 
@@ -564,7 +567,7 @@ class TpuStorage(
         lo, hi = full & ((1 << 64) - 1), full >> 64
         slices = self._disk.fetch_trace_raw(
             lo & 0xFFFFFFFF, lo >> 32, hi & 0xFFFFFFFF, hi >> 32,
-            strict=self.strict_trace_id,
+            strict=self.strict_trace_id, views=views,
         )
         spans = []
         for raw in slices:
@@ -714,7 +717,8 @@ class TpuStorage(
             # most `limit` traces.
             for key, spans in ram.items():
                 merged = merge_trace(
-                    spans + self._disk_trace_spans(spans[0].trace_id)
+                    spans
+                    + self._disk_trace_spans(spans[0].trace_id, views=views)
                 )
                 if request.test(merged):
                     out.append(merged)
