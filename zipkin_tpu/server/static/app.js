@@ -48,6 +48,13 @@ const svcColorSoft = name => `hsl(${svcHue(name)},52%,62%)`;
 
 const VIEWS = new Map();   // path prefix -> render(args, params)
 
+/* Navigation generation: bumped on every route(). Async view code must
+ * bail (`if (stale(gen)) return`) after each await before touching
+ * #view, or a slow in-flight fetch would overwrite the view the user
+ * navigated to meanwhile. */
+let _gen = 0;
+const stale = g => g !== _gen;
+
 function route() {
   const h = (location.hash.slice(1) || '/');
   const [path, qs] = h.split('?');
@@ -59,7 +66,9 @@ function route() {
     a.classList.toggle('active', a.dataset.nav === name);
   });
   closePanel();
-  view(parts.slice(1), params).catch(e => {
+  const gen = ++_gen;
+  view(parts.slice(1), params, gen).catch(e => {
+    if (stale(gen)) return;
     $('#view').innerHTML = `<section><p class="err">${esc(e.message)}</p></section>`;
   });
 }
@@ -86,8 +95,9 @@ async function serviceList() {
   return _services;
 }
 
-VIEWS.set('discover', async (args, params) => {
+VIEWS.set('discover', async (args, params, gen) => {
   const services = await serviceList();
+  if (stale(gen)) return;
   const el = $('#view');
   el.innerHTML = `
   <section><h2>Find traces</h2>
@@ -176,6 +186,7 @@ async function loadNames(selected) {
 }
 
 async function findTraces() {
+  const gen = _gen;
   const elq = $('#traces');
   const q = discoverQuery();
   const sort = q.get('sort') || 'newest';
@@ -185,9 +196,11 @@ async function findTraces() {
   let traces;
   try { traces = await get('/api/v2/traces?' + q); }
   catch (e) {
+    if (stale(gen)) return;
     elq.innerHTML = `<p class="err">search failed: ${esc(e.message)} (check the filter values)</p>`;
     return;
   }
+  if (stale(gen)) return;
   if (!traces.length) { elq.innerHTML = '<p class="muted">no traces matched</p>'; return; }
 
   const rows = traces.map(tr => {
@@ -315,10 +328,11 @@ function subtreeEnd(i) {
   return j;
 }
 
-VIEWS.set('trace', async (args) => {
+VIEWS.set('trace', async (args, params, gen) => {
   const id = hexOnly((args[0] || '').toLowerCase());
   if (!id) throw new Error('not a hex trace id');
   const [spans] = await Promise.all([get('/api/v2/trace/' + id), loadPctCtx()]);
+  if (stale(gen)) return;
   curTree = treeOrder(spans);
   curSpans = curTree.map(([s]) => s);
   collapsed = new Set();
@@ -540,7 +554,19 @@ VIEWS.set('dependencies', async (args, params) => {
 });
 
 async function deps(lookback) {
-  const links = await get('/api/v2/dependencies?endTs=' + Date.now() + '&lookback=' + lookback);
+  const gen = _gen;
+  let links;
+  try {
+    links = await get('/api/v2/dependencies?endTs=' + Date.now() + '&lookback=' + lookback);
+  } catch (e) {
+    // refresh clicks call deps() directly — a failed refetch must show
+    // inline, not vanish as an unhandled rejection behind stale data
+    if (stale(gen)) return;
+    $('#deptab').innerHTML = `<tr><td class="err">dependencies fetch failed: ${esc(e.message)}</td></tr>`;
+    $('#depgraph').setAttribute('height', '0');
+    return;
+  }
+  if (stale(gen)) return;
   curLinks = links;
   const t = $('#deptab');
   let h = '<tr><th>parent</th><th>child</th><th>calls</th><th>errors</th><th>error rate</th></tr>';
@@ -703,13 +729,15 @@ VIEWS.set('sketches', async (args, params) => {
 
 let _pctSort = 'count';
 async function loadPcts() {
+  const gen = _gen;
   const t = $('#pcttab');
   let q = '/api/v2/tpu/percentiles?q=0.5,0.9,0.99';
   const win = $('#pctwin').value;
   if (win) q += '&lookback=' + win;
   let rows;
   try { rows = await get(q); }
-  catch (e) { t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; return; }
+  catch (e) { if (!stale(gen)) t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; return; }
+  if (stale(gen)) return;
   const key = { count: r => -r.count, p50: r => -r.quantiles['0.5'], p99: r => -r.quantiles['0.99'],
     service: r => r.serviceName }[_pctSort] || (r => -r.count);
   rows.sort((a, b) => { const x = key(a), y = key(b); return x < y ? -1 : x > y ? 1 : 0; });
@@ -730,9 +758,11 @@ async function loadPcts() {
 }
 
 async function loadCards() {
+  const gen = _gen;
   const t = $('#cardtab');
   try {
     const cards = await get('/api/v2/tpu/cardinalities');
+    if (stale(gen)) return;
     let h = '<tr><th>service</th><th>distinct traces (est.)</th></tr>';
     const entries = Object.entries(cards).sort((a, b) => b[1] - a[1]);
     for (const [name, n] of entries) {
@@ -741,13 +771,15 @@ async function loadCards() {
         <td>${Math.round(n).toLocaleString()}</td></tr>`;
     }
     t.innerHTML = h;
-  } catch (e) { t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; }
+  } catch (e) { if (!stale(gen)) t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; }
 }
 
 async function loadCounters() {
+  const gen = _gen;
   const t = $('#ctrtab');
   try {
     const ctr = await get('/api/v2/tpu/counters');
+    if (stale(gen)) return;
     let h = '<tr><th>counter</th><th>value</th></tr>';
     for (const k of Object.keys(ctr).sort())
       h += `<tr><td>${esc(k)}</td><td>${Number(ctr[k]).toLocaleString()}</td></tr>`;
