@@ -413,3 +413,16 @@ class TestUiPage:
                 assert resp.status == 404, name
 
         run(scenario)
+
+    def test_ui_responses_carry_csp(self):
+        async def scenario(client):
+            for path in ("/zipkin/", "/zipkin/static/app.js"):
+                resp = await client.get(path)
+                csp = resp.headers.get("Content-Security-Policy", "")
+                assert "script-src 'self'" in csp, path
+                assert "frame-ancestors 'none'" in csp, path
+            # API responses are data, not documents — no CSP there
+            resp = await client.get("/api/v2/services")
+            assert "Content-Security-Policy" not in resp.headers
+
+        run(scenario)

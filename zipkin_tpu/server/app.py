@@ -207,10 +207,24 @@ class ZipkinServer:
         r.add_get("/zipkin/static/{name}", self.get_ui_asset)
         return app
 
+    # Span fields are attacker-controlled and the app renders them; even
+    # with the esc() discipline (pinned by tests/test_ui_assets.py) the
+    # UI ships defense-in-depth: only same-origin scripts execute, so an
+    # escaping regression cannot become script execution. 'unsafe-inline'
+    # styles stay allowed — the app positions bars with style attributes.
+    _UI_CSP = (
+        "default-src 'self'; script-src 'self'; style-src 'self' "
+        "'unsafe-inline'; img-src 'self' data:; object-src 'none'; "
+        "base-uri 'none'; frame-ancestors 'none'"
+    )
+
     async def get_ui(self, request: web.Request) -> web.Response:
         from zipkin_tpu.server.ui import index_page
 
-        return web.Response(text=index_page(), content_type="text/html")
+        return web.Response(
+            text=index_page(), content_type="text/html",
+            headers={"Content-Security-Policy": self._UI_CSP},
+        )
 
     async def get_ui_asset(self, request: web.Request) -> web.Response:
         from zipkin_tpu.server.ui import asset
@@ -219,7 +233,10 @@ class ZipkinServer:
         if found is None:
             return web.Response(status=404, text="no such asset")
         body, ctype = found
-        return web.Response(body=body, content_type=ctype)
+        return web.Response(
+            body=body, content_type=ctype,
+            headers={"Content-Security-Policy": self._UI_CSP},
+        )
 
     async def start(self) -> "ZipkinServer":
         app = self.make_app()

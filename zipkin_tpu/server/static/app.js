@@ -95,6 +95,16 @@ async function serviceList() {
   return _services;
 }
 
+let _tagKeys = null;
+async function tagKeyList() {
+  if (_tagKeys) return _tagKeys;
+  try {
+    const keys = await get('/api/v2/autocompleteKeys');
+    _tagKeys = Array.isArray(keys) ? keys : [];
+  } catch (e) { _tagKeys = []; } // endpoint disabled: plain input
+  return _tagKeys;
+}
+
 VIEWS.set('discover', async (args, params, gen) => {
   const services = await serviceList();
   if (stale(gen)) return;
@@ -104,7 +114,8 @@ VIEWS.set('discover', async (args, params, gen) => {
    <div style="display:flex;gap:6px;flex-wrap:wrap;align-items:center">
     <select id="svc"><option value="">all services</option></select>
     <select id="spanname"><option value="">all spans</option></select>
-    <input id="annq" placeholder="annotationQuery: error and http.method=GET" style="width:22em">
+    <input id="annq" list="tagkeys" placeholder="annotationQuery: error and http.method=GET" style="width:22em">
+    <datalist id="tagkeys"></datalist>
     <input id="mindur" type="number" placeholder="min µs" style="width:6.5em">
     <input id="maxdur" type="number" placeholder="max µs" style="width:6.5em">
     <select id="lookback">
@@ -122,6 +133,8 @@ VIEWS.set('discover', async (args, params, gen) => {
     <span style="margin-left:10px">trace id:
      <input id="tid" placeholder="hex trace id" style="width:17em">
      <button id="gotrace">open</button></span>
+    <label style="margin-left:10px" title="view a span-list JSON file without storing it">
+     local JSON: <input id="tracefile" type="file" accept=".json,application/json"></label>
    </div>
    <div id="traces" style="margin-top:10px"></div>
   </section>`;
@@ -139,6 +152,18 @@ VIEWS.set('discover', async (args, params, gen) => {
     if (params.has(key)) $('#' + id).value = params.get(key);
   }
   svcSel.addEventListener('change', loadNames);
+  // autocomplete tag keys (the Lens discover suggestions) — cached per
+  // session like serviceList(); best-effort
+  tagKeyList().then(keys => {
+    if (stale(gen)) return;
+    const dl = $('#tagkeys');
+    if (!dl) return;
+    for (const k of keys) {
+      const o = document.createElement('option');
+      o.value = String(k);
+      dl.append(o);
+    }
+  });
   $('#gosearch').addEventListener('click', () => {
     const target = '/?' + discoverQuery().toString();
     // same hash fires no hashchange — run the search directly so a
@@ -151,6 +176,28 @@ VIEWS.set('discover', async (args, params, gen) => {
     const id = hexOnly($('#tid').value.trim().toLowerCase());
     if (!id) { $('#traces').innerHTML = '<p class="err">not a hex trace id</p>'; return; }
     nav('/trace/' + id);
+  });
+  // the Lens "view my own JSON" path: render a span-list file in the
+  // waterfall without ingesting it (same escaping rules apply — the
+  // file is as untrusted as a POSTed payload)
+  $('#tracefile').addEventListener('change', async ev => {
+    const f = ev.target.files[0];
+    if (!f) return;
+    try {
+      const spans = JSON.parse(await f.text());
+      if (!Array.isArray(spans) || !spans.length) throw new Error('expected a non-empty span array');
+      // element-level check: a [null] or [{}] entry would otherwise
+      // blow up later inside treeOrder with a raw TypeError
+      for (const s of spans) {
+        if (!s || typeof s !== 'object' || typeof s.id !== 'string') {
+          throw new Error('every span needs at least an "id" string');
+        }
+      }
+      _localTrace = spans;
+      nav('/trace/local');
+    } catch (e) {
+      $('#traces').innerHTML = `<p class="err">cannot load trace JSON: ${esc(e.message)}</p>`;
+    }
   });
   if (params.has('serviceName')) await loadNames(params.get('spanName'));
   if ([...params.keys()].length) await findTraces();
@@ -263,6 +310,7 @@ let curSpans = [];          // tree-ordered spans of the open trace
 let curTree = [];           // [[span, depth], ...]
 let collapsed = new Set();  // indices whose subtree is folded
 let pctCtx = new Map();     // "service|span" -> {p50, p99}
+let _localTrace = null;     // spans loaded from a local JSON file
 
 async function loadPctCtx() {
   if (pctCtx.size) return;
@@ -329,9 +377,19 @@ function subtreeEnd(i) {
 }
 
 VIEWS.set('trace', async (args, params, gen) => {
-  const id = hexOnly((args[0] || '').toLowerCase());
-  if (!id) throw new Error('not a hex trace id');
-  const [spans] = await Promise.all([get('/api/v2/trace/' + id), loadPctCtx()]);
+  let id, spans;
+  if (args[0] === 'local' && _localTrace) {
+    // a file loaded on the Discover page; 'local' never collides with
+    // hexOnly ids and a cold deep-link to #/trace/local falls through
+    // to the hex branch's error
+    id = 'local';
+    spans = _localTrace;
+    await loadPctCtx();
+  } else {
+    id = hexOnly((args[0] || '').toLowerCase());
+    if (!id) throw new Error('not a hex trace id');
+    [spans] = await Promise.all([get('/api/v2/trace/' + id), loadPctCtx()]);
+  }
   if (stale(gen)) return;
   curTree = treeOrder(spans);
   curSpans = curTree.map(([s]) => s);
