@@ -309,6 +309,7 @@ async function findTraces() {
 let curSpans = [];          // tree-ordered spans of the open trace
 let curTree = [];           // [[span, depth], ...]
 let collapsed = new Set();  // indices whose subtree is folded
+let curT0 = 0, curTotal = 1;  // trace time origin/extent for renderRows
 let pctCtx = new Map();     // "service|span" -> {p50, p99}
 let _localTrace = null;     // spans loaded from a local JSON file
 
@@ -442,10 +443,12 @@ VIEWS.set('trace', async (args, params, gen) => {
     a.click();
     URL.revokeObjectURL(a.href);
   });
-  $('#expandall').addEventListener('click', () => { collapsed.clear(); renderRows(t0, total); });
+  $('#expandall').addEventListener('click', () => { collapsed.clear(); renderRows(); });
 
+  curT0 = t0;
+  curTotal = total;
   drawMinimap(t0, total);
-  renderRows(t0, total);
+  renderRows();
 });
 
 function drawMinimap(t0, total) {
@@ -480,13 +483,14 @@ function drawMinimap(t0, total) {
     // not rendered) — walk up to the nearest rendered ancestor row
     let row = null;
     while (idx >= 0 && !(row = document.querySelector(`tr.srow[data-idx="${idx}"]`))) idx--;
-    if (row) { row.scrollIntoView({ block: 'center' }); row.classList.add('sel');
-      setTimeout(() => row.classList.remove('sel'), 1200); }
+    if (row) selectRow(row, 'center');
   });
 }
 
-function renderRows(t0, total) {
+function renderRows() {
+  const t0 = curT0, total = curTotal;
   const tbody = $('#wfrows');
+  _selRow = null;
   let h = '';
   let skipUntil = -1;
   curTree.forEach(([s, depthv], i) => {
@@ -529,15 +533,53 @@ function renderRows(t0, total) {
       ev.stopPropagation();
       const i = +c.dataset.fold;
       collapsed.has(i) ? collapsed.delete(i) : collapsed.add(i);
-      renderRows(t0, total);
+      renderRows();
     }));
   tbody.querySelectorAll('tr.srow').forEach(row =>
-    row.addEventListener('click', () => {
-      tbody.querySelectorAll('tr.sel').forEach(r => r.classList.remove('sel'));
-      row.classList.add('sel');
-      spanDetail(+row.dataset.idx);
-    }));
+    row.addEventListener('click', () => selectRow(row)));
 }
+
+/* Single selection anchor for click, minimap and keyboard paths —
+ * tracked so selecting is O(1), not a sweep over (possibly 65k) rows. */
+let _selRow = null;
+function selectRow(row, scroll) {
+  if (_selRow && _selRow !== row) _selRow.classList.remove('sel');
+  _selRow = row;
+  row.classList.add('sel');
+  if (scroll) row.scrollIntoView({ block: scroll });
+  spanDetail(+row.dataset.idx);
+}
+
+/* Keyboard navigation on the waterfall: ↑/↓ move the selection over the
+ * RENDERED rows, ←/→ fold/unfold the selected subtree, Escape closes
+ * the span panel. Inactive while typing in a form control. */
+document.addEventListener('keydown', ev => {
+  if (!location.hash.startsWith('#/trace/')) return;
+  const tag = (ev.target.tagName || '').toLowerCase();
+  if (tag === 'input' || tag === 'select' || tag === 'textarea') return;
+  if (ev.key === 'Escape') { closePanel(); return; }
+  if (ev.key === 'ArrowDown' || ev.key === 'ArrowUp') {
+    ev.preventDefault();
+    const anchor = _selRow && _selRow.isConnected ? _selRow : null;
+    const next = anchor
+      ? (ev.key === 'ArrowDown'
+        ? anchor.nextElementSibling : anchor.previousElementSibling)
+      : document.querySelector('tr.srow');
+    if (next && next.classList.contains('srow')) selectRow(next, 'nearest');
+  } else if ((ev.key === 'ArrowLeft' || ev.key === 'ArrowRight')
+      && _selRow && _selRow.isConnected) {
+    const i = +_selRow.dataset.idx;
+    if (subtreeEnd(i) - i - 1 === 0) return;  // leaf: nothing to fold
+    // no-op fold/unfold must not rebuild a (possibly 65k-row) waterfall
+    if ((ev.key === 'ArrowLeft') === collapsed.has(i)) return;
+    ev.preventDefault();
+    if (ev.key === 'ArrowLeft') collapsed.add(i);
+    else collapsed.delete(i);
+    renderRows();
+    const again = document.querySelector(`tr.srow[data-idx="${i}"]`);
+    if (again) selectRow(again);
+  }
+});
 
 function spanDetail(i) {
   const s = curSpans[i];
