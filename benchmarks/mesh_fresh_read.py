@@ -71,11 +71,15 @@ def main() -> None:
         "scatter": count(r"= [^\n]*scatter\("),
     }
 
-    # execute one real dispatch on the mesh (full shapes)
+    # execute one real dispatch on the mesh (full shapes); the program
+    # ships the edge triple as one packed ZPK1 buffer
+    from zipkin_tpu import readpack
+
     t0 = time.perf_counter()
-    ctx, (idx, calls, errors) = agg._edges_fresh(agg.state, lo, hi)
-    jax.block_until_ready((idx, calls, errors))
+    ctx, packed = agg._edges_fresh(agg.state, lo, hi)
+    jax.block_until_ready(packed)
     wall_s = time.perf_counter() - t0
+    idx, calls, errors = readpack.pull(packed)
 
     # single-shard HLO for the growth comparison
     mesh1 = make_mesh(1)
@@ -89,7 +93,7 @@ def main() -> None:
         "max_services": cfg.max_services,
         "mesh_program": table,
         "single_shard_hlo_lines": hlo1.count("\n"),
-        "executed_ok": bool(int(jnp.asarray(idx).shape[0]) > 0),
+        "executed_ok": bool(int(idx.shape[0]) > 0),
         "execute_wall_s_cpu_mesh": round(wall_s, 2),
         "growth_note": (
             "collectives are exactly the edge-matrix merges; the link "

@@ -63,6 +63,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     from benchmarks.profile_link_ctx import synthetic_ring
+    from zipkin_tpu import readpack
     from zipkin_tpu.ops import linker
     from zipkin_tpu.tpu.state import AggConfig
 
@@ -112,6 +113,14 @@ def main() -> None:
         calls, errors = linker.emit_links(c, x.valid, s)
         return c, topk_compact(calls, errors)
 
+    def fresh_fused_packed(x):
+        # the PRODUCTION wire shape: ctx stays on device and the edge
+        # triple leaves as ONE packed ZPK1 buffer (readpack.pack fused
+        # as the program's last stage)
+        c = linker.link_context(x)
+        calls, errors = linker.emit_links(c, x.valid, s)
+        return c, readpack.pack(topk_compact(calls, errors))
+
     ctx = jax.jit(link_context)(x)
     ctx = jax.device_put(ctx)
     calls, errors = jax.jit(emit_links)(ctx, x.valid)
@@ -124,6 +133,32 @@ def main() -> None:
     results.update(capture_program_ms(jax.jit(topk_compact), (calls, errors)))
     results.update(capture_program_ms(jax.jit(fresh_fused_current), (x,)))
     results.update(capture_program_ms(jax.jit(fresh_fused_compact), (x,)))
+    results.update(capture_program_ms(jax.jit(fresh_fused_packed), (x,)))
+
+    # -- transfers-per-query + wall/device: legacy 3-pull vs packed 1 ----
+    import time
+
+    legacy_fn = jax.jit(fresh_fused_compact)
+    packed_fn = jax.jit(fresh_fused_packed)
+    jax.block_until_ready(legacy_fn(x))
+    jax.block_until_ready(packed_fn(x))
+
+    def timed_read(fn, pull, reps=5):
+        t0 = readpack.transfer_count()
+        xs = []
+        for _ in range(reps):
+            w0 = time.perf_counter()
+            pull(fn(x)[1])
+            xs.append((time.perf_counter() - w0) * 1e3)
+        per = (readpack.transfer_count() - t0) / reps
+        return round(sorted(xs)[len(xs) // 2], 2), round(per, 2)
+
+    legacy_wall, legacy_tr = timed_read(
+        legacy_fn, lambda triple: [readpack.device_get(a) for a in triple]
+    )
+    packed_wall, packed_tr = timed_read(
+        packed_fn, lambda buf: readpack.unpack(readpack.device_get(buf))
+    )
 
     # equivalence of the two compactions on this corpus
     i1, c1, e1 = jax.jit(topk_current)(calls, errors)
@@ -139,11 +174,21 @@ def main() -> None:
         if c > 0
     }
 
+    def ratio(wall, name):
+        dev = results.get(name)
+        return round(wall / dev, 2) if dev else None
+
     print(json.dumps({
         "artifact": "profile_fresh_read",
         "ring_capacity": r,
         "max_services": s,
         "device_ms_per_dispatch": results,
+        "read_wall_ms": {"legacy_3pull": legacy_wall, "packed": packed_wall},
+        "transfers_per_query": {"legacy_3pull": legacy_tr, "packed": packed_tr},
+        "wall_over_device": {
+            "legacy_3pull": ratio(legacy_wall, "fresh_fused_compact"),
+            "packed": ratio(packed_wall, "fresh_fused_packed"),
+        },
         "edge_sets_equal": cur == new,
         "n_edges": len(cur),
     }), flush=True)

@@ -49,6 +49,7 @@ def main() -> None:
     import numpy as np
 
     from tests.fixtures import lots_of_spans
+    from zipkin_tpu import readpack
     from zipkin_tpu.model import json_v2
     from zipkin_tpu.parallel.mesh import make_mesh
     from zipkin_tpu.tpu.state import AggConfig
@@ -119,8 +120,11 @@ def main() -> None:
 
     def percentiles_pend_fold():
         # the r2 read path: fold the pending buffer on EVERY read
+        # (packed like every read program — one pull)
         with agg.lock:
-            agg._quant_digest(agg.state, jnp.asarray(qs, jnp.float32))
+            readpack.pull(
+                agg._quant_digest(agg.state, jnp.asarray(qs, jnp.float32))
+            )
 
     def percentiles():
         # the production path: opportunistic flush (amortized — it
@@ -144,14 +148,44 @@ def main() -> None:
         "cardinalities": cardinalities,
     }
     walls = {}
+    transfers = {}
     for name, fn in reads.items():
         fn()  # compile + warm ctx where applicable
         xs = []
+        tc0 = readpack.transfer_count()
         for _ in range(reps):
             t1 = time.perf_counter()
             fn()
             xs.append((time.perf_counter() - t1) * 1e3)
+        # device→host pulls per query through the readpack chokepoint —
+        # the one-transfer invariant, measured (was 2-3 per read before
+        # the packed wire format)
+        transfers[name] = round(
+            (readpack.transfer_count() - tc0) / reps, 2
+        )
         walls[name] = xs
+
+    # -- legacy (3-pull) vs packed (1-pull) dependency-edge A/B ----------
+    # The raw (pre-pack) program still compiles; pulling its three
+    # arrays separately is exactly the pre-change read path. Parity must
+    # be byte-identical — packing is a wire format, not a recompute.
+    tc0 = readpack.transfer_count()
+    packed_res = agg.dependency_edges(lo_min, hi_min)
+    packed_transfers = readpack.transfer_count() - tc0
+    with agg.lock:
+        raw_out = agg._raw["edges"](
+            agg._link_context_cached(), agg.state,
+            jnp.uint32(lo_min), jnp.uint32(hi_min),
+        )
+    legacy_res = tuple(np.asarray(a) for a in raw_out)  # one pull EACH
+    edges_ab = {
+        "legacy_transfers": len(legacy_res),
+        "packed_transfers": int(packed_transfers),
+        "parity_byte_identical": bool(all(
+            p.dtype == l.dtype and np.array_equal(p, l)
+            for p, l in zip(packed_res, legacy_res)
+        )),
+    }
 
     # -- XPlane capture: actual device time per read ---------------------
     # The relay's per-dispatch noise (observed floor spread: 89ms to
@@ -220,6 +254,23 @@ def main() -> None:
     slo_device = slo_device and amortized_ok
 
     floor_p50 = _stats(floor)["p50"]
+    # wall/device per read: how much of the observed wall is transfer +
+    # dispatch overhead vs actual device work (1.0 = pure device time;
+    # the r5 pre-packing edge read sat near 19× on the tunneled relay)
+    READ_PROGRAM = {
+        "dependencies_ctx_cached": "spmd_edges",
+        "dependencies_ctx_rebuild": "spmd_edges_fresh",
+        "dependencies_rolled_only": "spmd_edges_rolled",
+        "percentiles_pend_fold": "spmd_quant_digest",
+        "percentiles_digest": "spmd_quant_digest_nopend",
+        "percentiles_windowed": "spmd_quant_whist",
+        "cardinalities": "spmd_card",
+    }
+    wall_over_device = {
+        name: round(_stats(walls[name])["p50"] / program_ms[prog], 2)
+        for name, prog in READ_PROGRAM.items()
+        if program_ms.get(prog)
+    }
     out = {
         "artifact": "query_slo",
         "spans": sent,
@@ -231,6 +282,9 @@ def main() -> None:
             k: round(max(_stats(v)["p50"] - floor_p50, 0.0), 2)
             for k, v in walls.items()
         },
+        "reads_transfers_per_query": transfers,
+        "reads_wall_over_device": wall_over_device,
+        "dependency_edges_transfer_ab": edges_ab,
         "program_device_ms_per_dispatch": program_ms,
         "slo_50ms_program_time": slo_device,
         "device_ops_ms": device_ms,

@@ -1,0 +1,117 @@
+"""Structural lint: the read surface of parallel/sharded.py cannot
+silently reintroduce per-array device→host pulls.
+
+The one-transfer invariant is behavioral (tests/test_readpack.py counts
+actual pulls), but a NEW entrypoint added next round would not be in
+that test's list — so this lint walks the AST and rejects the shapes
+that caused the r5 transfer amplification in the first place: methods
+that ``np.asarray`` several arrays, or return tuples of fresh pulls,
+instead of routing one packed buffer through ``self._pull``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+SRC = (
+    pathlib.Path(__file__).resolve().parents[1]
+    / "zipkin_tpu" / "parallel" / "sharded.py"
+)
+
+# the public query surface: every one of these must pull through the
+# counted chokepoint (add new read entrypoints HERE and to
+# tests/test_readpack.py, not to an exemption list)
+QUERY_ENTRYPOINTS = {
+    "merged_sketches",
+    "dependency_matrices",
+    "merged_digest",
+    "dependency_edges",
+    "windowed_histograms",
+    "quantiles",
+    "cardinalities",
+    "sketch_overview",
+}
+
+
+def _tree():
+    return ast.parse(SRC.read_text())
+
+
+def _agg_class(tree) -> ast.ClassDef:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ShardedAggregator":
+            return node
+    raise AssertionError("ShardedAggregator not found in sharded.py")
+
+
+def _np_asarray_calls(node) -> list:
+    return [
+        n for n in ast.walk(node)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "asarray"
+        and isinstance(n.func.value, ast.Name)
+        and n.func.value.id == "np"
+    ]
+
+
+def _calls_self_pull(fn: ast.FunctionDef) -> bool:
+    for n in ast.walk(fn):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "_pull"
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == "self"
+        ):
+            return True
+    return False
+
+
+def test_query_entrypoints_route_through_pull():
+    cls = _agg_class(_tree())
+    methods = {
+        n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+    }
+    missing = QUERY_ENTRYPOINTS - set(methods)
+    assert not missing, f"query entrypoints vanished from sharded.py: {missing}"
+    for name in sorted(QUERY_ENTRYPOINTS):
+        assert _calls_self_pull(methods[name]), (
+            f"{name}() does not route its device read through self._pull "
+            "— the one-transfer chokepoint (see zipkin_tpu/readpack.py)"
+        )
+
+
+def test_no_method_makes_multiple_host_pulls():
+    """≥2 np.asarray call sites in one aggregator method is the shape of
+    the pre-packing read path (one pull per output array). One is fine —
+    input coercion like np.asarray(qs) never touches the device."""
+    cls = _agg_class(_tree())
+    offenders = {
+        fn.name: len(_np_asarray_calls(fn))
+        for fn in cls.body
+        if isinstance(fn, ast.FunctionDef)
+        and len(_np_asarray_calls(fn)) >= 2
+    }
+    assert not offenders, (
+        f"aggregator methods with multiple np.asarray sites: {offenders} "
+        "— pack the program's outputs and pull once via self._pull"
+    )
+
+
+def test_no_bare_multi_asarray_return_tuples():
+    """``return np.asarray(a), np.asarray(b), ...`` anywhere in the file
+    is a multi-pull read being born; reject it at review time."""
+    bad = []
+    for node in ast.walk(_tree()):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Tuple):
+            pulls = sum(
+                1 for el in node.value.elts if _np_asarray_calls(el)
+            )
+            if pulls >= 2:
+                bad.append(node.lineno)
+    assert not bad, (
+        f"multi-array np.asarray return tuples at lines {bad} of "
+        "sharded.py — use readpack.pack + one pull instead"
+    )

@@ -1,0 +1,259 @@
+"""The one-transfer query read path (ZPK1 packed wire format).
+
+Two halves:
+
+1. pack/unpack round trips — every supported dtype/shape crosses the
+   device→host boundary byte-identically, and the host side gets
+   zero-copy views into the single pulled buffer.
+2. The structural invariant itself — every public query entrypoint on
+   ShardedAggregator performs EXACTLY ONE device→host transfer, counted
+   at the readpack.device_get chokepoint. A regression that reintroduces
+   per-array pulls fails here, not in a profile three rounds later.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zipkin_tpu import readpack
+from zipkin_tpu.model.span import Endpoint, Kind, Span
+from zipkin_tpu.parallel.mesh import make_mesh
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+CFG = AggConfig(
+    max_services=32, max_keys=64, hll_precision=8, digest_centroids=16,
+    digest_buffer=2048, ring_capacity=512, link_buckets=8,
+    bucket_minutes=60, hist_slices=2,
+)
+
+
+class TestWireFormat:
+    @pytest.mark.parametrize("arrays", [
+        [np.arange(7, dtype=np.uint32)],
+        [np.arange(13, dtype=np.uint8)],                  # odd length: padded
+        [np.array([True, False, True])],                  # bool → u8 storage
+        [np.linspace(0, 1, 24, dtype=np.float32).reshape(2, 3, 4)],  # 3-D
+        [np.float32(3.5)],                                # 0-d scalar
+        [np.arange(5, dtype=np.int64)],                   # 8-byte widening
+        [np.arange(4, dtype=np.float64) * 0.25],
+        [                                                 # mixed multi-section
+            np.arange(6, dtype=np.int32).reshape(2, 3),
+            np.array([1.5, -2.5], np.float32),
+            np.arange(3, dtype=np.uint8),
+            np.array([[True], [False]]),
+        ],
+    ])
+    def test_roundtrip(self, arrays):
+        buf = np.asarray(readpack.pack(arrays))
+        out = readpack.unpack(buf)
+        assert len(out) == len(arrays)
+        for want, got in zip(arrays, out):
+            want = np.asarray(want)
+            # pack sees the JAX-canonicalized dtype (64-bit narrows to
+            # 32-bit with x64 off — matching what any jitted read
+            # program actually produces); bool round-trips as bool
+            # (stored as u8, viewed back copy-free)
+            exp = np.dtype(jnp.asarray(want).dtype)
+            assert got.dtype == exp
+            assert got.shape == want.shape
+            np.testing.assert_array_equal(got, want.astype(exp))
+
+    def test_unpack_is_zero_copy(self):
+        # np.array copy: the device pull itself is read-only host memory
+        buf = np.array(readpack.pack([np.arange(8, dtype=np.uint32)]))
+        (view,) = readpack.unpack(buf)
+        assert view.base is not None
+        # mutating the buffer shows through the view: same memory
+        hdr_words = 2 + readpack._SECTION_WORDS
+        buf[hdr_words] = 424242
+        assert view.flat[0] == 424242
+
+    def test_describe(self):
+        buf = readpack.pack([
+            np.zeros((2, 3), np.float32), np.zeros(5, np.uint8)
+        ])
+        assert readpack.describe(np.asarray(buf)) == [
+            ("float32", (2, 3), 24), ("uint8", (5,), 5)
+        ]
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError, match="magic"):
+            readpack.unpack(np.zeros(16, np.uint32))
+
+    def test_unsupported_dtype_rejected(self):
+        with pytest.raises(NotImplementedError):
+            readpack.pack([np.zeros(4, np.float16)])
+
+    def test_device_get_counts(self):
+        before = readpack.transfer_count()
+        readpack.device_get(jnp.arange(4))
+        readpack.device_get(jnp.arange(4))
+        assert readpack.transfer_count() == before + 2
+
+
+def _span(i: int, ts_min: int, err: bool = False):
+    ts = ts_min * 60_000_000
+    tid = f"{(ts_min << 20) + i + 1:016x}"
+    sid = f"{i + 1:016x}"
+    tags = {"error": "true"} if err else {}
+    return [
+        Span.create(
+            trace_id=tid, id=sid, kind=Kind.CLIENT, name="get",
+            timestamp=ts, duration=100 + i, tags=tags,
+            local_endpoint=Endpoint.create("frontend", "10.0.0.1"),
+        ),
+        Span.create(
+            trace_id=tid, id=sid, shared=True, kind=Kind.SERVER,
+            name="get", timestamp=ts, duration=80 + i,
+            local_endpoint=Endpoint.create("backend", "10.0.0.2"),
+        ),
+    ]
+
+
+OLD_MIN = 100
+NEW_MIN = 10_000
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    store = TpuStorage(config=CFG, mesh=make_mesh(1), pad_to_multiple=64)
+    agg = store.agg
+    store.accept(
+        [s for i in range(30) for s in _span(i, OLD_MIN, err=i % 5 == 0)]
+    ).execute()
+    agg.rollup_now()
+    # displace the ring so an OLD_MIN window is provably fully rolled
+    for b in range(4):
+        store.accept([
+            Span.create(
+                trace_id=f"{0xB0000 + b * 200 + i:016x}",
+                id=f"{0xB0000 + b * 200 + i:016x}",
+                timestamp=NEW_MIN * 60_000_000, duration=5,
+            )
+            for i in range(200)
+        ]).execute()
+    return store
+
+
+def _one_transfer(agg, fn):
+    """Assert fn() makes exactly one pull through the chokepoint, seen
+    by BOTH ledgers (module counter and the aggregator's read_stats)."""
+    fn()  # warm: compile outside the counted window
+    mod0 = readpack.transfer_count()
+    agg0 = agg.read_stats["host_transfers"]
+    out = fn()
+    assert readpack.transfer_count() - mod0 == 1
+    assert agg.read_stats["host_transfers"] - agg0 == 1
+    return out
+
+
+class TestOneTransferInvariant:
+    def test_merged_sketches(self, loaded):
+        hist, hll, ctr = _one_transfer(
+            loaded.agg, loaded.agg.merged_sketches
+        )
+        assert hist.shape[0] == CFG.max_keys and hll.ndim == 2
+
+    def test_dependency_matrices(self, loaded):
+        agg = loaded.agg
+        calls, errors = _one_transfer(
+            agg, lambda: agg.dependency_matrices(0, 1 << 31)
+        )
+        assert calls.shape == (CFG.max_services, CFG.max_services)
+        assert calls.sum() > 0
+
+    def test_merged_digest(self, loaded):
+        digest = _one_transfer(loaded.agg, loaded.agg.merged_digest)
+        assert isinstance(digest, np.ndarray)
+        assert digest.shape == (CFG.max_keys, CFG.digest_centroids, 2)
+
+    def test_dependency_edges_all_three_branches(self, loaded):
+        agg = loaded.agg
+
+        # rolled-only branch: window disjoint from every resident span
+        assert agg.window_fully_rolled(OLD_MIN - 5, OLD_MIN + 5)
+        idx, calls, errs = _one_transfer(
+            agg, lambda: agg.dependency_edges(OLD_MIN - 5, OLD_MIN + 5)
+        )
+        assert calls.sum() > 0
+
+        # fresh branch: invalidate the ctx cache before each call
+        def fresh():
+            with agg.lock:
+                agg._ctx_cache = (-1, None)
+            return agg.dependency_edges(NEW_MIN - 5, NEW_MIN + 5)
+
+        _one_transfer(agg, fresh)
+
+        # cached-ctx branch (the fresh call above primed the cache)
+        assert agg._ctx_cache[0] == agg.write_version
+        _one_transfer(
+            agg, lambda: agg.dependency_edges(NEW_MIN - 5, NEW_MIN + 5)
+        )
+
+    def test_windowed_histograms(self, loaded):
+        agg = loaded.agg
+        out = _one_transfer(
+            agg, lambda: agg.windowed_histograms(0, 1 << 31)
+        )
+        assert out.shape[0] == CFG.max_keys
+
+    def test_quantiles_all_sources(self, loaded):
+        agg = loaded.agg
+        for call in (
+            lambda: agg.quantiles([0.5, 0.99]),
+            lambda: agg.quantiles([0.5, 0.99], source="hist"),
+            lambda: agg.quantiles(
+                [0.5, 0.99], ts_lo_min=0, ts_hi_min=1 << 31
+            ),
+        ):
+            q, n = _one_transfer(agg, call)
+            assert q.shape[1] == 2 and n.shape[0] == CFG.max_keys
+
+    def test_cardinalities(self, loaded):
+        est = _one_transfer(loaded.agg, loaded.agg.cardinalities)
+        assert est.shape == (CFG.max_services + 1,)
+
+    def test_sketch_overview(self, loaded):
+        agg = loaded.agg
+        q, n, est = _one_transfer(
+            agg, lambda: agg.sketch_overview([0.5, 0.9, 0.99])
+        )
+        assert q.shape == (CFG.max_keys, 3)
+        assert n.shape == (CFG.max_keys,)
+        assert est.shape == (CFG.max_services + 1,)
+        # the coalesced read answers match the three separate reads
+        q2, n2 = agg.quantiles([0.5, 0.9, 0.99])
+        np.testing.assert_array_equal(q, q2)
+        np.testing.assert_array_equal(n, n2)
+        np.testing.assert_array_equal(est, agg.cardinalities())
+
+
+class TestPackedParity:
+    def test_edges_byte_identical_vs_raw_path(self, loaded):
+        """The packed program is a WIRE format change, not a recompute:
+        unpacked sections must be byte-identical to the raw (pre-pack)
+        program's separately-pulled arrays."""
+        agg = loaded.agg
+        lo, hi = jnp.uint32(NEW_MIN - 5), jnp.uint32(NEW_MIN + 5)
+        with agg.lock:
+            ctx = agg._link_context_cached()
+            packed = readpack.pull(agg._edges(ctx, agg.state, lo, hi))
+            raw = agg._raw["edges"](ctx, agg.state, lo, hi)
+        raw = [np.asarray(a) for a in raw]
+        assert len(packed) == len(raw) == 3
+        for p, r in zip(packed, raw):
+            assert p.dtype == r.dtype
+            np.testing.assert_array_equal(p, r)
+
+    def test_store_overview_shape(self, loaded):
+        body = loaded.sketch_overview([0.5, 0.99])
+        assert set(body) == {"percentiles", "cardinalities", "counters"}
+        assert body["cardinalities"]["_global"] > 0
+        assert body["counters"]["spans"] > 0
+        assert "hostTransfers" in body["counters"]
+        rows = loaded.latency_quantiles([0.5, 0.99])
+        assert body["percentiles"] == rows

@@ -40,7 +40,10 @@ def _strip_js(src: str) -> str:
             elif c == "/" and nxt == "*":
                 mode = "/*"
                 i += 1
-            elif c == "/" and prev_significant in "=(,:;![&|?+{}":
+            elif c == "/" and prev_significant in "=(,:;![&|?+{}>":
+                # '>' covers arrow bodies: `s => /^[0-9a-f]+$/.test(s)`
+                # (an operand before '/' ends in an identifier/digit, so
+                # comparison followed by division still lexes as division)
                 mode = "re"
             else:
                 out.append(c)

@@ -16,9 +16,13 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6 ships it under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from zipkin_tpu import readpack
 from zipkin_tpu.ops import linker as dlink
 from zipkin_tpu.tpu import ingest as ing
 from zipkin_tpu.tpu.columnar import (
@@ -63,6 +67,37 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     n_shards = int(np.prod(mesh.devices.shape))
     sharding = NamedSharding(mesh, P(SHARD_AXIS))
 
+    def _packed(inner, name):
+        """Production wire variant of a read program: the same device
+        program with a readpack.pack stage fused on the end, so the
+        whole answer is ONE 1-D uint32 buffer — one device→host pull
+        per query, however many logical outputs. ``name`` keeps the
+        XPlane program attribution (jit_spmd_*) stable across rounds."""
+
+        def wrapper(*args):
+            out = inner(*args)
+            if not isinstance(out, tuple):
+                out = (out,)
+            return readpack.pack(out)
+
+        wrapper.__name__ = name
+        return jax.jit(wrapper)
+
+    # shard_map's static replication/varying-manual-axes check can't see
+    # through all_gather+row_merge, and older jax (< 0.5) additionally
+    # has no replication rule at all for lax.while_loop (the linker's
+    # ancestor chase) — so every program tracing those turns the check
+    # off. The flag is check_vma on current jax, check_rep before 0.6.
+    import inspect
+
+    _sm_params = inspect.signature(shard_map).parameters
+    if "check_vma" in _sm_params:
+        _vma_off = dict(check_vma=False)
+    elif "check_rep" in _sm_params:
+        _vma_off = dict(check_rep=False)
+    else:  # pragma: no cover - future jax with neither knob
+        _vma_off = {}
+
     def _init() -> AggState:
         # broadcast the REAL initial leaves, not zeros: init_state's
         # sentinels are load-bearing (link_perm must be a permutation,
@@ -100,6 +135,7 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
                 mesh=mesh,
                 in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
                 out_specs=P(SHARD_AXIS),
+                **_vma_off,
             ),
             donate_argnums=(0,),
         )
@@ -125,6 +161,7 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         shard_map(
             spmd_link_ctx, mesh=mesh,
             in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS),
+            **_vma_off,
         )
     )
 
@@ -134,14 +171,14 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi, ctx=c)
         return jax.lax.psum(calls, SHARD_AXIS), jax.lax.psum(errors, SHARD_AXIS)
 
-    links = jax.jit(
-        shard_map(
-            spmd_links,
-            mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
-            out_specs=P(),
-        )
+    links_sm = shard_map(
+        spmd_links,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+        out_specs=P(),
+        **_vma_off,
     )
+    links = _packed(links_sm, "spmd_links")
 
     def spmd_merge(state: AggState):
         s = jax.tree_util.tree_map(lambda a: a[0], state)
@@ -151,9 +188,11 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
             jax.lax.psum(s.counters, SHARD_AXIS),
         )
 
-    merge = jax.jit(
-        shard_map(spmd_merge, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
+    merge_sm = shard_map(
+        spmd_merge, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P(),
+        **_vma_off,
     )
+    merge = _packed(merge_sm, "spmd_merge")
 
     def spmd_flush(state: AggState) -> AggState:
         s = jax.tree_util.tree_map(lambda a: a[0], state)
@@ -162,7 +201,8 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
 
     flush = jax.jit(
         shard_map(
-            spmd_flush, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS)
+            spmd_flush, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+            out_specs=P(SHARD_AXIS), **_vma_off,
         ),
         donate_argnums=(0,),
     )
@@ -174,7 +214,8 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
 
     rollup = jax.jit(
         shard_map(
-            spmd_rollup, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P(SHARD_AXIS)
+            spmd_rollup, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+            out_specs=P(SHARD_AXIS), **_vma_off,
         ),
         donate_argnums=(0,),
     )
@@ -185,12 +226,11 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
             ing.windowed_hist(config, s, ts_lo, ts_hi), SHARD_AXIS
         )
 
-    whist = jax.jit(
-        shard_map(
-            spmd_whist, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(),
-        )
+    whist_sm = shard_map(
+        spmd_whist, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(), **_vma_off,
     )
+    whist = _packed(whist_sm, "spmd_whist")
 
     def _gather_recluster(local):
         """all_gather per-shard [K, C, 2] digests over ICI and recluster
@@ -229,15 +269,11 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         local = tdigest.row_merge(s.digest, partial)  # [K, C, 2]
         return _gather_recluster(local)
 
-    # replication can't be statically inferred through all_gather+row_merge
-    _vma_off = dict(check_vma=False)
-
-    digest_read = jax.jit(
-        shard_map(
-            _merged_digest_of, mesh=mesh, in_specs=(P(SHARD_AXIS),),
-            out_specs=P(), **_vma_off,
-        )
+    digest_read_sm = shard_map(
+        _merged_digest_of, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+        out_specs=P(), **_vma_off,
     )
+    digest_read = _packed(digest_read_sm, "spmd_digest_read")
 
     # quantile reads computed ON DEVICE: one dispatch, [K, Q] + [K] counts
     # over the tunnel instead of the dense [K, BUCKETS] histogram (28MB at
@@ -250,12 +286,11 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         counts = jax.lax.psum(histogram.total_count(s.hist), SHARD_AXIS)
         return tdigest.quantile(merged, qs), counts
 
-    quant_digest = jax.jit(
-        shard_map(
-            spmd_quant_digest, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P()), out_specs=P(), **_vma_off,
-        )
+    quant_digest_sm = shard_map(
+        spmd_quant_digest, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P()), out_specs=P(), **_vma_off,
     )
+    quant_digest = _packed(quant_digest_sm, "spmd_quant_digest")
 
     def spmd_quant_digest_nopend(state: AggState, qs):
         """Digest quantiles when the host KNOWS the pending buffer is
@@ -268,11 +303,12 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         counts = jax.lax.psum(histogram.total_count(s.hist), SHARD_AXIS)
         return tdigest.quantile(merged, qs), counts
 
-    quant_digest_nopend = jax.jit(
-        shard_map(
-            spmd_quant_digest_nopend, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P()), out_specs=P(), **_vma_off,
-        )
+    quant_digest_nopend_sm = shard_map(
+        spmd_quant_digest_nopend, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P()), out_specs=P(), **_vma_off,
+    )
+    quant_digest_nopend = _packed(
+        quant_digest_nopend_sm, "spmd_quant_digest_nopend"
     )
 
     def spmd_quant_hist(state: AggState, qs):
@@ -282,12 +318,11 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         merged = jax.lax.psum(s.hist, SHARD_AXIS)
         return histogram.quantile(merged, qs), histogram.total_count(merged)
 
-    quant_hist = jax.jit(
-        shard_map(
-            spmd_quant_hist, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P()), out_specs=P(),
-        )
+    quant_hist_sm = shard_map(
+        spmd_quant_hist, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P()), out_specs=P(), **_vma_off,
     )
+    quant_hist = _packed(quant_hist_sm, "spmd_quant_hist")
 
     def spmd_quant_whist(state: AggState, ts_lo, ts_hi, qs):
         from zipkin_tpu.ops import histogram
@@ -295,12 +330,11 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         merged = spmd_whist(state, ts_lo, ts_hi)
         return histogram.quantile(merged, qs), histogram.total_count(merged)
 
-    quant_whist = jax.jit(
-        shard_map(
-            spmd_quant_whist, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(), P(), P()), out_specs=P(),
-        )
+    quant_whist_sm = shard_map(
+        spmd_quant_whist, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P(), P()), out_specs=P(), **_vma_off,
     )
+    quant_whist = _packed(quant_whist_sm, "spmd_quant_whist")
 
     # dependency edges compacted ON DEVICE: the first E nonzero cells of
     # the merged [S, S] call matrix via prefix-sum compaction (cumsum +
@@ -334,12 +368,12 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         calls, errors = ing.dependency_links(config, s, ts_lo, ts_hi, ctx=c)
         return _edge_topk(calls, errors)
 
-    edges = jax.jit(
-        shard_map(
-            spmd_edges, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()), out_specs=P(),
-        )
+    edges_sm = shard_map(
+        spmd_edges, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()), out_specs=P(),
+        **_vma_off,
     )
+    edges = _packed(edges_sm, "spmd_edges")
 
     def spmd_edges_fresh(ctxless_state: AggState, ts_lo, ts_hi):
         """The FRESH dependency read: first query after a write. One
@@ -355,13 +389,21 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         ctx_out = jax.tree_util.tree_map(lambda a: a[None], c)
         return ctx_out, _edge_topk(calls, errors)
 
-    edges_fresh = jax.jit(
-        shard_map(
-            spmd_edges_fresh, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(), P()),
-            out_specs=(P(SHARD_AXIS), P()),
-        )
+    edges_fresh_sm = shard_map(
+        spmd_edges_fresh, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P()),
+        out_specs=(P(SHARD_AXIS), P()),
+        **_vma_off,
     )
+
+    def _edges_fresh_packed(state, ts_lo, ts_hi):
+        # ctx stays ON DEVICE (it primes the per-version cache; only the
+        # edge triple crosses the tunnel, as one packed buffer)
+        ctx, triple = edges_fresh_sm(state, ts_lo, ts_hi)
+        return ctx, readpack.pack(triple)
+
+    _edges_fresh_packed.__name__ = "spmd_edges_fresh"
+    edges_fresh = jax.jit(_edges_fresh_packed)
 
     def spmd_edges_rolled(state: AggState, ts_lo, ts_hi):
         """Edges from the rollup buckets ALONE — no ring sort, no link
@@ -371,12 +413,11 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         calls, errors = ing.rolled_links(config, s, ts_lo, ts_hi)
         return _edge_topk(calls, errors)
 
-    edges_rolled = jax.jit(
-        shard_map(
-            spmd_edges_rolled, mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(),
-        )
+    edges_rolled_sm = shard_map(
+        spmd_edges_rolled, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(), P()), out_specs=P(), **_vma_off,
     )
+    edges_rolled = _packed(edges_rolled_sm, "spmd_edges_rolled")
     # device-side state clone for snapshots: runs in ms on device, so
     # the aggregator lock is held only for the dispatch — the host pull
     # of the copy (~state_bytes over the transport) happens lock-free
@@ -393,13 +434,57 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         merged = jax.lax.pmax(s.hll, SHARD_AXIS)
         return hll_ops.estimate(merged)  # [S+1] f32 — KBs, not registers
 
-    card = jax.jit(
-        shard_map(spmd_card, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
+    card_sm = shard_map(
+        spmd_card, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P()
     )
+    card = _packed(card_sm, "spmd_card")
+
+    def spmd_overview(state: AggState, qs):
+        """The coalesced sketch read: digest quantiles + per-key counts
+        + HLL cardinalities in ONE dispatch — what the server's
+        /api/v2/tpu/overview endpoint serves, replacing three separate
+        aggregator dispatches (and three HTTP round trips from the UI
+        sketch page) with one packed pull. Assumes the pending digest
+        buffer is empty (the host flushes first, as the digest quantile
+        path already does)."""
+        from zipkin_tpu.ops import histogram, tdigest
+        from zipkin_tpu.ops import hll as hll_ops
+
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        merged = _gather_recluster(s.digest)
+        counts = jax.lax.psum(histogram.total_count(s.hist), SHARD_AXIS)
+        est = hll_ops.estimate(jax.lax.pmax(s.hll, SHARD_AXIS))
+        return tdigest.quantile(merged, qs), counts, est
+
+    overview_sm = shard_map(
+        spmd_overview, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P()), out_specs=P(), **_vma_off,
+    )
+    overview = _packed(overview_sm, "spmd_overview")
+
+    # the pre-pack (multi-output) jits, kept compilable for the packed
+    # wire parity tests and the transfers-3→1 A/B in benchmarks — jit is
+    # lazy, so an un-dispatched raw variant costs nothing
+    raw = {
+        "merge": jax.jit(merge_sm),
+        "links": jax.jit(links_sm),
+        "whist": jax.jit(whist_sm),
+        "digest_read": jax.jit(digest_read_sm),
+        "edges": jax.jit(edges_sm),
+        "edges_fresh": jax.jit(edges_fresh_sm),
+        "edges_rolled": jax.jit(edges_rolled_sm),
+        "quant_digest": jax.jit(quant_digest_sm),
+        "quant_digest_nopend": jax.jit(quant_digest_nopend_sm),
+        "quant_hist": jax.jit(quant_hist_sm),
+        "quant_whist": jax.jit(quant_whist_sm),
+        "card": jax.jit(card_sm),
+        "overview": jax.jit(overview_sm),
+    }
     return (
         init, step_variants, links, merge, flush, rollup, whist, digest_read,
         edges, edges_fresh, edges_rolled, quant_digest, quant_digest_nopend,
         quant_hist, quant_whist, card, link_ctx, snap_copy, sharding,
+        overview, raw,
     )
 
 
@@ -420,6 +505,7 @@ class ShardedAggregator:
             self._edges_fresh, self._edges_rolled, self._quant_digest,
             self._quant_digest_nopend, self._quant_hist, self._quant_whist,
             self._card, self._link_ctx, self._snap_copy, self._sharding,
+            self._overview, self._raw,
         ) = _compiled_programs(config, mesh)
         self._step = self._step_variants[(False, False)]
         # device-resident LinkContext for the current write_version (the
@@ -465,7 +551,14 @@ class ShardedAggregator:
 
         self._resident: "deque" = deque()
         self._shard_cursor = np.zeros(self.n_shards, np.int64)
-        self.read_stats = {"rolled_only_reads": 0, "ctx_reads": 0}
+        self.read_stats = {
+            "rolled_only_reads": 0,
+            "ctx_reads": 0,
+            # device→host pulls made on behalf of queries (should track
+            # query count 1:1 — the one-transfer invariant; pinned by
+            # tests/test_readpack.py)
+            "host_transfers": 0,
+        }
         # write-ahead log seam (tpu/wal.py): when set, every fused batch
         # is logged inside the state lock and wal_seq records the last
         # sequence folded into self.state — snapshots read both under
@@ -561,12 +654,24 @@ class ShardedAggregator:
                 )
 
     # -- read path (merged across shards over ICI) -----------------------
+    #
+    # Every entrypoint below ends in exactly ONE device→host transfer:
+    # the compiled program packs its outputs into a single ZPK1 buffer on
+    # device (readpack.pack fused as the program's last stage) and
+    # self._pull makes the one counted jax.device_get. Do not add bare
+    # np.asarray pulls here — tests/test_read_path_lint.py rejects them.
+
+    def _pull(self, packed) -> list:
+        """THE query-path device→host pull: one counted transfer, then
+        zero-copy unpack of the ZPK1 sections (callers hold the lock)."""
+        self.read_stats["host_transfers"] += 1
+        return readpack.unpack(readpack.device_get(packed))
 
     def merged_sketches(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(hist [K,B], hll [S+1,m], counters) merged over all shards."""
         with self.lock:
-            hist, hll_regs, counters = self._merge(self.state)
-            return np.asarray(hist), np.asarray(hll_regs), np.asarray(counters)
+            hist, hll_regs, counters = self._pull(self._merge(self.state))
+            return hist, hll_regs, counters
 
     def _link_context_cached(self):
         """Device LinkContext for the current state (callers hold lock)."""
@@ -579,13 +684,13 @@ class ShardedAggregator:
         self, ts_lo_min: int, ts_hi_min: int
     ) -> Tuple[np.ndarray, np.ndarray]:
         with self.lock:
-            calls, errors = self._links(
+            calls, errors = self._pull(self._links(
                 self._link_context_cached(), self.state,
                 jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min),
-            )
-            return np.asarray(calls), np.asarray(errors)
+            ))
+            return calls, errors
 
-    def merged_digest(self) -> jnp.ndarray:
+    def merged_digest(self) -> np.ndarray:
         """[K, C, 2] t-digest merged across shards in ONE device dispatch.
 
         A PURE READ: each shard's pending points are folded into a
@@ -594,7 +699,8 @@ class ShardedAggregator:
         recluster, and only the final [K, C, 2] crosses to the host.
         """
         with self.lock:
-            return self._digest_read(self.state)
+            (digest,) = self._pull(self._digest_read(self.state))
+            return digest
 
     def window_fully_rolled(self, ts_lo_min: int, ts_hi_min: int) -> bool:
         """True when no ring-resident span's timestamp can fall in the
@@ -619,26 +725,28 @@ class ShardedAggregator:
         with self.lock:
             if self.window_fully_rolled(ts_lo_min, ts_hi_min):
                 self.read_stats["rolled_only_reads"] += 1
-                idx, calls, errors = self._edges_rolled(
+                packed = self._edges_rolled(
                     self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
                 )
             elif self._ctx_cache[0] != self.write_version:
                 # FRESH read (first query after a write): one fused
                 # dispatch computes ctx from the maintained sort order +
                 # the windowed edges, and primes the ctx cache for
-                # follow-up windows at this version
+                # follow-up windows at this version. The ctx stays on
+                # device; only the packed edge triple crosses.
                 self.read_stats["ctx_reads"] += 1
-                ctx, (idx, calls, errors) = self._edges_fresh(
+                ctx, packed = self._edges_fresh(
                     self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
                 )
                 self._ctx_cache = (self.write_version, ctx)
             else:
                 self.read_stats["ctx_reads"] += 1
-                idx, calls, errors = self._edges(
+                packed = self._edges(
                     self._ctx_cache[1], self.state,
                     jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min),
                 )
-            return np.asarray(idx), np.asarray(calls), np.asarray(errors)
+            idx, calls, errors = self._pull(packed)
+            return idx, calls, errors
 
     def _flush_now(self) -> None:
         """Compact the pending digest buffer and reset the host mirror —
@@ -695,10 +803,10 @@ class ShardedAggregator:
         """[K, BUCKETS] histogram over the window, merged across shards
         (empty rows where the window predates the slice retention)."""
         with self.lock:
-            out = self._whist(
+            (out,) = self._pull(self._whist(
                 self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min)
-            )
-            return np.asarray(out)
+            ))
+            return out
 
     def quantiles(
         self,
@@ -719,7 +827,7 @@ class ShardedAggregator:
         qarr = jnp.asarray(np.asarray(qs, np.float32))
         with self.lock:
             if ts_lo_min is not None:
-                q, n = self._quant_whist(
+                packed = self._quant_whist(
                     self.state, jnp.uint32(ts_lo_min), jnp.uint32(ts_hi_min),
                     qarr,
                 )
@@ -732,16 +840,31 @@ class ShardedAggregator:
                     # the flush pays it once and the read itself rides
                     # the cheap no-pend program
                     self._flush_now()
-                q, n = self._quant_digest_nopend(self.state, qarr)
+                packed = self._quant_digest_nopend(self.state, qarr)
             else:
-                q, n = self._quant_hist(self.state, qarr)
-            return np.asarray(q), np.asarray(n)
+                packed = self._quant_hist(self.state, qarr)
+            q, n = self._pull(packed)
+            return q, n
 
     def cardinalities(self) -> np.ndarray:
         """[S+1] HLL distinct-trace estimates (last row global), computed
         on device — only the estimates cross the tunnel, not registers."""
         with self.lock:
-            return np.asarray(self._card(self.state))
+            (est,) = self._pull(self._card(self.state))
+            return est
+
+    def sketch_overview(self, qs) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """([K, Q] digest quantiles, [K] counts, [S+1] HLL estimates) in
+        ONE dispatch and ONE transfer — the coalesced read behind the
+        server's /api/v2/tpu/overview endpoint, which previously issued
+        three aggregator reads (quantiles + cardinalities + counters)
+        per HTTP request."""
+        qarr = jnp.asarray(np.asarray(qs, np.float32))
+        with self.lock:
+            if self._pend_lanes:
+                self._flush_now()  # same flush-then-read as quantiles()
+            q, n, est = self._pull(self._overview(self.state, qarr))
+            return q, n, est
 
     def sync_pend_lanes(self) -> None:
         """Re-derive the host pend mirror from device state (call after

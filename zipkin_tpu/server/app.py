@@ -196,6 +196,7 @@ class ZipkinServer:
             r.add_get("/api/v2/tpu/percentiles", self.get_tpu_percentiles)
             r.add_get("/api/v2/tpu/cardinalities", self.get_tpu_cardinalities)
             r.add_get("/api/v2/tpu/counters", self.get_tpu_counters)
+            r.add_get("/api/v2/tpu/overview", self.get_tpu_overview)
             r.add_post("/api/v2/tpu/snapshot", self.post_tpu_snapshot)
         r.add_get("/health", self.get_health)
         r.add_get("/info", self.get_info)
@@ -558,6 +559,29 @@ class ZipkinServer:
         return web.json_response(
             await asyncio.to_thread(self.storage.ingest_counters)
         )
+
+    async def get_tpu_overview(self, request: web.Request) -> web.Response:
+        """Percentiles + cardinalities + counters in ONE storage read —
+        one aggregator dispatch and one device→host transfer — instead
+        of the three requests the UI sketch page used to issue."""
+        if not hasattr(self.storage, "sketch_overview"):
+            return web.Response(
+                status=501, text="storage does not serve sketch_overview"
+            )
+        raw_q = request.query.get("q", "0.5,0.9,0.99")
+        try:
+            qs = [float(x) for x in raw_q.split(",") if x]
+            if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
+                raise ValueError(f"q out of range: {raw_q!r}")
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        body = await asyncio.to_thread(
+            self.storage.sketch_overview,
+            qs,
+            request.query.get("serviceName"),
+            request.query.get("spanName"),
+        )
+        return web.json_response(body)
 
     async def post_tpu_snapshot(self, request: web.Request) -> web.Response:
         if not hasattr(self.storage, "snapshot"):

@@ -248,6 +248,9 @@ async function findTraces() {
     return;
   }
   if (stale(gen)) return;
+  // an empty trace array has no root span — tr.reduce with no initial
+  // value throws on it and would blank the whole results table
+  traces = traces.filter(tr => tr.length);
   if (!traces.length) { elq.innerHTML = '<p class="muted">no traces matched</p>'; return; }
 
   const rows = traces.map(tr => {
@@ -554,10 +557,12 @@ function selectRow(row, scroll) {
  * RENDERED rows, ←/→ fold/unfold the selected subtree, Escape closes
  * the span panel. Inactive while typing in a form control. */
 document.addEventListener('keydown', ev => {
-  if (!location.hash.startsWith('#/trace/')) return;
   const tag = (ev.target.tagName || '').toLowerCase();
   if (tag === 'input' || tag === 'select' || tag === 'textarea') return;
+  // Escape works on EVERY view with a span panel (the Dependencies view
+  // opens one too), so it is handled before the trace-route gate
   if (ev.key === 'Escape') { closePanel(); return; }
+  if (!location.hash.startsWith('#/trace/')) return;
   if (ev.key === 'ArrowDown' || ev.key === 'ArrowUp') {
     ev.preventDefault();
     const anchor = _selRow && _selRow.isConnected ? _selRow : null;
@@ -822,22 +827,12 @@ VIEWS.set('sketches', async (args, params) => {
       out.textContent = r.ok ? 'saved: ' + (await r.json()).snapshot : 'HTTP ' + r.status + ': ' + await r.text();
     } catch (e) { out.textContent = String(e); }
   });
-  await loadPcts();
-  await loadCards();
-  await loadCounters();
+  await loadOverview();
 });
 
 let _pctSort = 'count';
-async function loadPcts() {
-  const gen = _gen;
+function renderPcts(rows) {
   const t = $('#pcttab');
-  let q = '/api/v2/tpu/percentiles?q=0.5,0.9,0.99';
-  const win = $('#pctwin').value;
-  if (win) q += '&lookback=' + win;
-  let rows;
-  try { rows = await get(q); }
-  catch (e) { if (!stale(gen)) t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; return; }
-  if (stale(gen)) return;
   const key = { count: r => -r.count, p50: r => -r.quantiles['0.5'], p99: r => -r.quantiles['0.99'],
     service: r => r.serviceName }[_pctSort] || (r => -r.count);
   rows.sort((a, b) => { const x = key(a), y = key(b); return x < y ? -1 : x > y ? 1 : 0; });
@@ -857,20 +852,75 @@ async function loadPcts() {
     th.addEventListener('click', () => { _pctSort = th.dataset.k; loadPcts(); }));
 }
 
+async function loadPcts() {
+  const gen = _gen;
+  const t = $('#pcttab');
+  const win = $('#pctwin').value;
+  // no window = the all-time digest view, which the coalesced overview
+  // serves (with cards + counters) in ONE request and one device pull
+  if (!win) return loadOverview();
+  const q = '/api/v2/tpu/percentiles?q=0.5,0.9,0.99&lookback=' + win;
+  let rows;
+  try { rows = await get(q); }
+  catch (e) { if (!stale(gen)) t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; return; }
+  if (stale(gen)) return;
+  renderPcts(rows);
+}
+
+function renderCards(cards) {
+  const t = $('#cardtab');
+  let h = '<tr><th>service</th><th>distinct traces (est.)</th></tr>';
+  const entries = Object.entries(cards).sort((a, b) => b[1] - a[1]);
+  for (const [name, n] of entries) {
+    const label = name === '_global' ? '(all services)' : name;
+    h += `<tr><td>${name === '_global' ? '<b>' + esc(label) + '</b>' : esc(label)}</td>
+      <td>${Math.round(n).toLocaleString()}</td></tr>`;
+  }
+  t.innerHTML = h;
+}
+
+function renderCounters(ctr) {
+  const t = $('#ctrtab');
+  let h = '<tr><th>counter</th><th>value</th></tr>';
+  for (const k of Object.keys(ctr).sort())
+    h += `<tr><td>${esc(k)}</td><td>${Number(ctr[k]).toLocaleString()}</td></tr>`;
+  t.innerHTML = h;
+}
+
+async function loadOverview() {
+  const gen = _gen;
+  try {
+    const o = await get('/api/v2/tpu/overview?q=0.5,0.9,0.99');
+    if (stale(gen)) return;
+    renderPcts(o.percentiles);
+    renderCards(o.cardinalities);
+    renderCounters(o.counters);
+  } catch (e) {
+    if (stale(gen)) return;
+    // older server without the coalesced endpoint: three requests
+    await loadLegacyPcts();
+    await loadCards();
+    await loadCounters();
+  }
+}
+
+async function loadLegacyPcts() {
+  const gen = _gen;
+  const t = $('#pcttab');
+  let rows;
+  try { rows = await get('/api/v2/tpu/percentiles?q=0.5,0.9,0.99'); }
+  catch (e) { if (!stale(gen)) t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; return; }
+  if (stale(gen)) return;
+  renderPcts(rows);
+}
+
 async function loadCards() {
   const gen = _gen;
   const t = $('#cardtab');
   try {
     const cards = await get('/api/v2/tpu/cardinalities');
     if (stale(gen)) return;
-    let h = '<tr><th>service</th><th>distinct traces (est.)</th></tr>';
-    const entries = Object.entries(cards).sort((a, b) => b[1] - a[1]);
-    for (const [name, n] of entries) {
-      const label = name === '_global' ? '(all services)' : name;
-      h += `<tr><td>${name === '_global' ? '<b>' + esc(label) + '</b>' : esc(label)}</td>
-        <td>${Math.round(n).toLocaleString()}</td></tr>`;
-    }
-    t.innerHTML = h;
+    renderCards(cards);
   } catch (e) { if (!stale(gen)) t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; }
 }
 
@@ -880,11 +930,8 @@ async function loadCounters() {
   try {
     const ctr = await get('/api/v2/tpu/counters');
     if (stale(gen)) return;
-    let h = '<tr><th>counter</th><th>value</th></tr>';
-    for (const k of Object.keys(ctr).sort())
-      h += `<tr><td>${esc(k)}</td><td>${Number(ctr[k]).toLocaleString()}</td></tr>`;
-    t.innerHTML = h;
-  } catch (e) { t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; }
+    renderCounters(ctr);
+  } catch (e) { if (stale(gen)) return; t.innerHTML = '<tr><td class="muted">TPU storage not enabled</td></tr>'; }
 }
 
 boot();

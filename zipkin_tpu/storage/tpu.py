@@ -76,6 +76,10 @@ class TpuStorage(_CoreTpuStorage):
             wal = wal_mod.WriteAheadLog(wal_dir, fsync=wal_fsync)
             wal_mod.replay(self, wal, from_seq=self.agg.wal_seq)
             wal_mod.attach(self, wal)
+        # the transfer ledger measures SERVING traffic (one pull per
+        # query is the invariant); boot-time restore/replay pulls are
+        # not queries, so the count starts clean here
+        self.agg.read_stats["host_transfers"] = 0
 
     def snapshot(self) -> Optional[str]:
         """Persist device sketch state (see tpu/snapshot.py); returns

@@ -945,6 +945,18 @@ class TpuStorage(
                 lambda: self.agg.quantiles(qs, source=src),
             )
 
+        return self._quantile_rows(qs, source_q, counts, service_name, span_name)
+
+    def _quantile_rows(
+        self,
+        qs: Sequence[float],
+        source_q: np.ndarray,
+        counts: np.ndarray,
+        service_name: Optional[str],
+        span_name: Optional[str],
+    ) -> List[dict]:
+        """Shape pulled ([K, Q], [K]) quantile arrays into API rows —
+        shared by latency_quantiles and the coalesced sketch_overview."""
         want_svc = (
             self.vocab.services.get(service_name.lower()) if service_name else None
         )
@@ -977,9 +989,7 @@ class TpuStorage(
             )
         return out
 
-    def trace_cardinalities(self) -> dict:
-        """Estimated distinct trace counts: {"_global": n, service: n, ...}."""
-        est = self._cached_read("card", self.agg.cardinalities)
+    def _cardinality_rows(self, est: np.ndarray) -> dict:
         out = {"_global": float(est[self.config.global_hll_row])}
         for name in self.vocab.services.names:
             sid = self.vocab.services.get(name)
@@ -987,10 +997,44 @@ class TpuStorage(
                 out[name] = float(est[sid])
         return out
 
+    def trace_cardinalities(self) -> dict:
+        """Estimated distinct trace counts: {"_global": n, service: n, ...}."""
+        est = self._cached_read("card", self.agg.cardinalities)
+        return self._cardinality_rows(est)
+
+    def sketch_overview(
+        self,
+        qs: Sequence[float],
+        service_name: Optional[str] = None,
+        span_name: Optional[str] = None,
+    ) -> dict:
+        """Everything the UI sketch page shows, from ONE device dispatch
+        and ONE device→host transfer: {"percentiles": latency_quantiles
+        rows, "cardinalities": trace_cardinalities dict, "counters":
+        ingest_counters dict}. Replaces three aggregator reads (and three
+        HTTP round trips) per page refresh."""
+        qkey = ",".join(f"{q:.6g}" for q in qs)
+        source_q, counts, est = self._cached_read(
+            f"overview:{qkey}",
+            lambda: self.agg.sketch_overview(qs),
+        )
+        return {
+            "percentiles": self._quantile_rows(
+                qs, source_q, counts, service_name, span_name
+            ),
+            "cardinalities": self._cardinality_rows(est),
+            "counters": self.ingest_counters(),
+        }
+
     def ingest_counters(self) -> dict:
         # host counters: exact and wrap-free (device counters are u32)
         return {
             **self.agg.host_counters,
+            # read-side ledger: hostTransfers / query counts ≈ 1 is the
+            # one-transfer invariant, observable in production
+            "hostTransfers": self.agg.read_stats["host_transfers"],
+            "rolledOnlyReads": self.agg.read_stats["rolled_only_reads"],
+            "ctxReads": self.agg.read_stats["ctx_reads"],
             "serviceVocabOverflow": self.vocab.services.overflow,
             "keyVocabOverflow": self.vocab._overflow,
             # the fast path interns in C; rejected entries never reach
