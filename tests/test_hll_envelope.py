@@ -1,0 +1,78 @@
+"""HLL operating-envelope guard: the threshold is DERIVED from the r5
+bias curve (PROFILE_r05 §5), pinned here so neither the curve nor the
+derivation drifts silently, and the store counts + gauges estimates
+that cross it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from tests.test_tpu_store import small_store
+from zipkin_tpu.ops import hll
+
+
+class TestEnvelopeDerivation:
+    def test_pinned_near_two_billion_at_p11(self):
+        n = hll.envelope_max(11)
+        # the "~2e9 at p=11" crossing: between the 1e9 (-1.2%) and the
+        # 2e9 (-4.4%) curve points, where |bias| = half the 3σ gate
+        assert 1.6e9 < n < 2.0e9
+        assert math.isclose(
+            hll.bias_fraction(n),
+            1.5 * hll.standard_error(11),
+            rel_tol=1e-6,
+        )
+
+    def test_tightens_with_precision(self):
+        # more registers → less noise → bias surfaces earlier
+        assert hll.envelope_max(14) < hll.envelope_max(11)
+        assert hll.envelope_max(11) <= hll.envelope_max(8)
+        # and never past the 32-bit hash boundary, at any precision
+        assert hll.envelope_max(4) <= 4.0e9
+
+    def test_bias_curve_interpolation(self):
+        # clamped outside the measured range, log-log between points
+        assert hll.bias_fraction(1e8) == hll.BIAS_CURVE[0][1]
+        assert hll.bias_fraction(8e9) == hll.BIAS_CURVE[-1][1]
+        mid = hll.bias_fraction(1.5e9)
+        assert hll.BIAS_CURVE[1][1] < mid < hll.BIAS_CURVE[2][1]
+        for n, b in hll.BIAS_CURVE:
+            assert math.isclose(hll.bias_fraction(n), b, rel_tol=1e-9)
+
+
+class TestStoreGuard:
+    def test_counter_and_gauge_track_crossings(self):
+        store = small_store()
+        try:
+            counters = store.ingest_counters()
+            assert counters["hllEnvelopeExceeded"] == 0
+            assert counters["hllBeyondEnvelopeRows"] == 0
+
+            rows = store.config.hll_rows
+            est = np.zeros(rows, np.float32)
+            est[store.config.global_hll_row] = 2 * store._hll_envelope_max
+            store._cardinality_rows(est)
+            counters = store.ingest_counters()
+            assert counters["hllEnvelopeExceeded"] == 1
+            assert counters["hllBeyondEnvelopeRows"] == 1
+
+            # gauge recovers when estimates come back inside; the
+            # counter is monotonic
+            store._cardinality_rows(np.zeros(rows, np.float32))
+            counters = store.ingest_counters()
+            assert counters["hllEnvelopeExceeded"] == 1
+            assert counters["hllBeyondEnvelopeRows"] == 0
+        finally:
+            store.close()
+
+    def test_real_reads_stay_inside_envelope(self):
+        store = small_store()
+        try:
+            cards = store.trace_cardinalities()
+            assert cards["_global"] < store._hll_envelope_max
+            assert store.ingest_counters()["hllEnvelopeExceeded"] == 0
+        finally:
+            store.close()

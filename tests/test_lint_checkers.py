@@ -1,0 +1,433 @@
+"""ZT-lint checker fixtures: one positive + one negative snippet per
+rule, pragma suppression (line, next-line, def-scoped, reasonless →
+ZT00), baseline round-trip, and select/ignore plumbing.
+
+Every positive fixture doubles as the "fails when its checker is
+disabled" demonstration: the same snippet linted with the rule ignored
+must produce nothing, so the finding provably comes from that checker.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from zipkin_tpu.lint import all_checkers, run_paths
+from zipkin_tpu.lint.cli import main as lint_main
+
+
+def lint(tmp_path, source, name="mod.py", **kwargs):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return run_paths([str(p)], root=tmp_path, **kwargs)
+
+
+def rules(result):
+    return sorted(f.rule for f in result.findings)
+
+
+def assert_rule_owned(tmp_path, source, rule, name="mod.py"):
+    """The finding is present — and vanishes when its checker is
+    disabled (so the fixture fails if the checker is unregistered)."""
+    assert rule in rules(lint(tmp_path, source, name=name))
+    assert rule not in rules(
+        lint(tmp_path, source, name=name, ignore={rule})
+    )
+
+
+# -- ZT01: host-transfer chokepoint -------------------------------------
+
+
+ZT01_POSITIVE = """
+    import jax
+    import numpy as np
+
+    class Agg:
+        def read(self):
+            return np.asarray(self.state.hist)
+"""
+
+
+def test_zt01_flags_device_pull_outside_chokepoint(tmp_path):
+    assert_rule_owned(tmp_path, ZT01_POSITIVE, "ZT01")
+
+
+def test_zt01_ignores_host_input_coercion(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def coerce(qs):
+            return np.asarray(qs, np.float32)
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt01_ignores_jax_device_metadata(tmp_path):
+    # jax.devices() returns host-side Device handles, not device arrays
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def make_mesh():
+            return np.asarray(jax.devices())
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt01_flags_item_and_float_of_device_values(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        class Agg:
+            def peek(self):
+                total = jnp.sum(self.state.counters)
+                return float(total), self.state.pend_pos.item()
+        """,
+        select={"ZT01"},
+    )
+    assert rules(result).count("ZT01") >= 2
+
+
+# -- ZT02: multi-pull read shapes ---------------------------------------
+
+
+ZT02_POSITIVE = """
+    import jax
+    import numpy as np
+
+    class Agg:
+        def read(self):
+            a = np.asarray(self.state.hist)
+            b = np.asarray(self.state.hll)
+            return a, b
+"""
+
+
+def test_zt02_flags_two_pulls_per_method(tmp_path):
+    assert_rule_owned(tmp_path, ZT02_POSITIVE, "ZT02")
+
+
+def test_zt02_allows_single_packed_pull(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        class Agg:
+            def read(self):
+                return self._pull(self._merge(self.state))
+        """,
+        select={"ZT02"},
+    )
+    assert rules(result) == []
+
+
+# -- ZT03: jit-recompile hazards ----------------------------------------
+
+
+ZT03_POSITIVE = """
+    import jax
+
+    def build(config):
+        return jax.jit(lambda state: state)
+"""
+
+
+def test_zt03_flags_jit_factory_without_cache(tmp_path):
+    assert_rule_owned(tmp_path, ZT03_POSITIVE, "ZT03")
+
+
+def test_zt03_allows_lru_cached_factory(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import functools
+
+        import jax
+
+        @functools.lru_cache(maxsize=None)
+        def build(config):
+            return jax.jit(lambda state: state)
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt03_jit_decorator_is_not_a_construction_site(tmp_path):
+    # regression: @functools.partial(jax.jit, ...) evaluates at def
+    # time, not per call (ops/pallas_hll.py shape)
+    result = lint(
+        tmp_path,
+        """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("interpret",))
+        def step(x, interpret=False):
+            return x
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt03_flags_jit_in_loop_and_varying_scalar(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        step = jax.jit(lambda s, n: s)
+
+        def replay(state, batches):
+            for n in batches:
+                state = step(state, n)
+            return state
+
+        def rebuild(sizes):
+            fns = []
+            for _ in sizes:
+                fns.append(jax.jit(lambda s: s))
+            return fns
+        """,
+        select={"ZT03"},
+    )
+    assert rules(result).count("ZT03") == 2
+
+
+# -- ZT04: lock discipline ----------------------------------------------
+
+
+ZT04_POSITIVE = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+
+        def reset(self):
+            self.count = 0
+"""
+
+
+def test_zt04_flags_lock_free_write_of_guarded_attr(tmp_path):
+    assert_rule_owned(tmp_path, ZT04_POSITIVE, "ZT04")
+
+
+def test_zt04_quiet_when_all_writes_guarded(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def reset(self):
+                with self._lock:
+                    self.count = 0
+        """,
+    )
+    assert rules(result) == []
+
+
+# -- ZT05: donation misuse ----------------------------------------------
+
+
+ZT05_POSITIVE = """
+    import jax
+
+    step = jax.jit(lambda s, x: s, donate_argnums=(0,))
+
+    def run(state, x):
+        out = step(state, x)
+        return out, state.sum()
+"""
+
+
+def test_zt05_flags_read_after_donation(tmp_path):
+    assert_rule_owned(tmp_path, ZT05_POSITIVE, "ZT05")
+
+
+def test_zt05_allows_rebinding_the_donated_name(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        step = jax.jit(lambda s, x: s, donate_argnums=(0,))
+
+        def run(state, x):
+            state = step(state, x)
+            return state.sum()
+        """,
+    )
+    assert rules(result) == []
+
+
+# -- ZT06: blocking sync ------------------------------------------------
+
+
+ZT06_POSITIVE = """
+    import jax
+
+    def serve(agg):
+        agg.block_until_ready()
+"""
+
+
+def test_zt06_flags_blocking_sync_in_serving_code(tmp_path):
+    assert_rule_owned(tmp_path, ZT06_POSITIVE, "ZT06")
+
+
+def test_zt06_exempts_benchmarks_and_tests(tmp_path):
+    for name in ("benchmarks/bench.py", "tests/test_x.py"):
+        assert rules(lint(tmp_path, ZT06_POSITIVE, name=name)) == []
+
+
+# -- pragmas and ZT00 ----------------------------------------------------
+
+
+def test_pragma_with_reason_suppresses(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        def serve(agg):
+            agg.block_until_ready()  # zt-lint: disable=ZT06 — drain contract
+        """,
+    )
+    assert rules(result) == []
+    assert [f.rule for f in result.suppressed] == ["ZT06"]
+
+
+def test_own_line_pragma_governs_next_code_line(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        def serve(agg):
+            # zt-lint: disable=ZT06 — justification too long for the line
+            # (continuation comments are skipped over)
+            agg.block_until_ready()
+        """,
+    )
+    assert rules(result) == []
+    assert [f.rule for f in result.suppressed] == ["ZT06"]
+
+
+def test_def_scoped_pragma_covers_whole_body(tmp_path):
+    result = lint(
+        tmp_path,
+        ZT04_POSITIVE.replace(
+            "def reset(self):",
+            "def reset(self):  # zt-lint: disable=ZT04 — callers hold _lock",
+        ),
+    )
+    assert rules(result) == []
+    assert [f.rule for f in result.suppressed] == ["ZT04"]
+
+
+def test_reasonless_pragma_is_its_own_finding(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        def serve(agg):
+            agg.block_until_ready()  # zt-lint: disable=ZT06
+        """,
+    )
+    assert rules(result) == ["ZT00"]  # ZT06 suppressed, hygiene flagged
+
+
+def test_zt00_cannot_be_ignored(tmp_path):
+    source = """
+        import jax
+
+        def serve(agg):
+            agg.block_until_ready()  # zt-lint: disable=ZT06
+    """
+    assert rules(lint(tmp_path, source, ignore={"ZT00"})) == ["ZT00"]
+    assert rules(lint(tmp_path, source, select={"ZT01"})) == ["ZT00"]
+
+
+def test_pragma_does_not_suppress_other_rules(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        def serve(agg):
+            agg.block_until_ready()  # zt-lint: disable=ZT01 — wrong rule
+        """,
+    )
+    assert rules(result) == ["ZT06"]
+
+
+# -- baseline + CLI ------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path, capsys, monkeypatch):
+    # the CLI resolves paths relative to cwd; pytest's tmp dir name
+    # contains "test_", which would trip ZT06's test-path exemption if
+    # the file fell back to its absolute path
+    monkeypatch.chdir(tmp_path)
+    p = tmp_path / "legacy.py"
+    p.write_text(textwrap.dedent(ZT06_POSITIVE))
+    baseline = tmp_path / "baseline.json"
+    assert lint_main([str(p), "--write-baseline", str(baseline)]) == 0
+    # the accepted finding no longer fails the run...
+    assert lint_main([str(p), "--baseline", str(baseline)]) == 0
+    # ...but a NEW violation (distinct source line — fingerprints hash
+    # the stripped line, not the line number) still does
+    p.write_text(
+        textwrap.dedent(ZT06_POSITIVE)
+        + "\n\ndef serve2(agg2):\n    agg2.block_until_ready()\n"
+    )
+    assert lint_main([str(p), "--baseline", str(baseline)]) == 1
+
+
+def test_cli_exit_codes_and_rule_listing(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert lint_main([str(clean)]) == 0
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(textwrap.dedent(ZT06_POSITIVE))
+    assert lint_main([str(dirty)]) == 1
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in all_checkers():
+        assert rule in out
+
+
+def test_unparsable_file_is_an_error_not_a_crash(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    result = run_paths([str(bad)], root=tmp_path)
+    assert result.exit_code == 1
+    assert result.errors and "bad.py" in result.errors[0]

@@ -1,0 +1,54 @@
+"""Tier-1 gate: the shipped tree passes its own static analyzer.
+
+Successor to tests/test_read_path_lint.py — where that file pinned one
+module's read surface, ZT-lint walks every module for every TPU
+invariant (one-transfer chokepoint, recompile hazards, lock discipline,
+donation misuse, blocking syncs), so a new entrypoint added anywhere is
+checked without registering it in a test. Runs the linter IN-PROCESS
+(same code path as ``python -m zipkin_tpu.lint zipkin_tpu/``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from zipkin_tpu.lint import all_checkers, run_paths
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_shipped_tree_lints_clean():
+    result = run_paths([str(ROOT / "zipkin_tpu")], root=ROOT)
+    assert not result.errors, result.errors
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings
+    )
+
+
+def test_lint_package_lints_itself_clean():
+    """Meta: the analyzer holds itself to its own bar — zero findings
+    AND zero suppressions (the framework never needs a pragma)."""
+    result = run_paths([str(ROOT / "zipkin_tpu" / "lint")], root=ROOT)
+    assert not result.errors
+    assert result.findings == []
+    assert result.suppressed == []
+
+
+def test_full_rule_catalog_registered():
+    assert sorted(all_checkers()) == [
+        "ZT00", "ZT01", "ZT02", "ZT03", "ZT04", "ZT05", "ZT06",
+    ]
+
+
+def test_every_shipped_suppression_carries_a_reason():
+    """Belt over ZT00's braces: pragmas in the shipped tree all parse
+    with non-empty justifications."""
+    from zipkin_tpu.lint.core import PRAGMA_RE
+
+    bad = []
+    for path in sorted((ROOT / "zipkin_tpu").rglob("*.py")):
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            m = PRAGMA_RE.search(line)
+            if m and not m.group("reason").strip(" \t-—:()"):
+                bad.append(f"{path}:{i}")
+    assert bad == []

@@ -106,6 +106,21 @@ class TestTpuServer:
 
         run(scenario)
 
+    def test_prometheus_exposes_ingest_counters(self):
+        """Every ingest_counters key auto-exports as a zipkin_tpu_*
+        gauge — including the HLL envelope guard pair."""
+        async def scenario(client):
+            await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"},
+            )
+            text = await (await client.get("/prometheus")).text()
+            assert "zipkin_tpu_host_transfers " in text
+            assert "zipkin_tpu_hll_envelope_exceeded 0" in text
+            assert "zipkin_tpu_hll_beyond_envelope_rows 0" in text
+
+        run(scenario)
+
     def test_health_includes_tpu_storage(self):
         async def scenario(client):
             resp = await client.get("/health")

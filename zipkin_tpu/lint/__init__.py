@@ -1,0 +1,32 @@
+"""ZT-lint: repo-wide static analysis of the TPU invariants.
+
+The invariants this system's performance rests on — one device→host
+transfer per query, no serving-time recompiles, lock-coherent shared
+state, donation discipline, no stray device syncs — are exactly the
+ones a reviewer cannot reliably re-check by hand every round. This
+package makes them mechanical: an AST checker framework (core.py), a
+function-local device-taint analysis (taint.py), six rules grounded in
+real past regressions (checkers/), inline suppression pragmas with
+mandatory justifications, baselines, and a CLI
+(``python -m zipkin_tpu.lint``). tests/test_lint_clean.py runs the full
+tree through it in tier-1, so every future PR is gated.
+
+Public API: :func:`zipkin_tpu.lint.core.run_paths` and the
+:class:`~zipkin_tpu.lint.core.Finding` dataclass; see ARCHITECTURE.md
+"Static analysis" for the rule catalog and how to add a checker.
+
+Import note: nothing here imports jax/numpy — the linter parses source,
+it never executes it, so it runs in any stdlib-only context.
+"""
+
+from zipkin_tpu.lint.core import (  # noqa: F401
+    Checker,
+    Finding,
+    Module,
+    RunResult,
+    all_checkers,
+    load_baseline,
+    register,
+    run_paths,
+    write_baseline,
+)

@@ -1,0 +1,7 @@
+"""``python -m zipkin_tpu.lint`` entry point."""
+
+import sys
+
+from zipkin_tpu.lint.cli import main
+
+sys.exit(main())

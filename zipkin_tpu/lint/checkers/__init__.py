@@ -1,0 +1,27 @@
+"""ZT-lint checkers. Importing this package registers every rule.
+
+Rule catalog (grounded in real past regressions — see ARCHITECTURE.md
+"Static analysis" for the full story per rule):
+
+- ZT00 suppression hygiene (meta): a ``zt-lint: disable`` pragma with no
+  justification text.
+- ZT01 host-transfer chokepoint: device→host coercion outside
+  ``readpack``.
+- ZT02 multi-pull shapes: ≥2 host pulls in one function, or
+  multi-``np.asarray`` return tuples.
+- ZT03 jit-recompile hazards: ``jax.jit`` constructed per call/iteration;
+  varying Python scalars passed positionally to jitted callables.
+- ZT04 lock discipline: attributes written under a lock in one method
+  but lock-free in another.
+- ZT05 donation misuse: a donated argument read after the donating call.
+- ZT06 blocking sync: ``block_until_ready`` on serving paths.
+"""
+
+from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
+    blocking,
+    donation,
+    locks,
+    pragmas,
+    recompile,
+    transfers,
+)

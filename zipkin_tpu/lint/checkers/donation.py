@@ -1,0 +1,170 @@
+"""ZT05 — donation misuse.
+
+Every state-mutating program in the repo donates its input buffers
+(``jax.jit(..., donate_argnums=(0,))``): the step/flush/rollup programs
+reuse the state's device memory, which is why a reader racing a step
+touches deleted arrays (the aggregator lock exists for exactly this).
+The SAFE idiom is ``state = step(state, batch)`` — the donated name is
+rebound to the result in the same statement, so nothing can read the
+deleted buffer afterwards.
+
+Rule: resolve callables bound from ``jax.jit(..., donate_argnums=...)``
+(by local/module name, or ``self._name`` bound in a method). At each
+call site, the argument expressions at donated positions are captured;
+if the call's result is NOT assigned back to that same expression, any
+later read of the expression in the same function scope is a finding —
+a read of donated (deleted) device memory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from zipkin_tpu.lint.core import Checker, Module, register
+
+_FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _donated_positions(call: ast.Call):
+    """The donate_argnums literal of a jax.jit(...) call, or None."""
+    f = call.func
+    is_jit = (isinstance(f, ast.Attribute) and f.attr == "jit") or (
+        isinstance(f, ast.Name) and f.id == "jit"
+    )
+    if not is_jit:
+        return None
+    for k in call.keywords:
+        if k.arg != "donate_argnums":
+            continue
+        v = k.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = tuple(
+                el.value
+                for el in v.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, int)
+            )
+            return out or None
+    return None
+
+
+def _donating_names(module: Module) -> Dict[str, Tuple[int, ...]]:
+    """name -> donated positions, for ``x = jax.jit(..., donate_argnums)``
+    and ``self._x = jax.jit(...)`` bindings anywhere in the module."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        pos = _donated_positions(node.value)
+        if pos is None:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = pos
+            elif (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out[f"self.{t.attr}"] = pos
+    return out
+
+
+def _call_name(call: ast.Call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "self"
+    ):
+        return f"self.{f.attr}"
+    return None
+
+
+def _expr_key(node: ast.AST):
+    """A stable identity for 'the same expression': Name or self.attr
+    chains only — anything fancier can't be tracked reliably."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        inner = _expr_key(node.value)
+        return f"{inner}.{node.attr}" if inner else None
+    return None
+
+
+@register
+class DonationMisuse(Checker):
+    rule = "ZT05"
+    severity = "error"
+    name = "donation-misuse"
+    doc = "a donated argument read after the donating call"
+    hint = (
+        "rebind the result to the donated name in the same statement "
+        "(state = step(state, ...)) or drop donate_argnums"
+    )
+
+    def check(self, module: Module):
+        if not module.imported_roots & {"jax", "jnp"}:
+            return
+        donating = _donating_names(module)
+        if not donating:
+            return
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, _FUNC_KINDS):
+                yield from self._check_scope(module, fn, donating)
+
+    def _check_scope(self, module: Module, fn: ast.AST, donating):
+        # donated expression keys and the line their buffers died on
+        dead: Dict[str, int] = {}
+        calls: List[Tuple[ast.Call, str]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name in donating:
+                    calls.append((node, name))
+        calls.sort(key=lambda c: (c[0].lineno, c[0].col_offset))
+        for call, name in calls:
+            # the NEAREST enclosing statement decides the same-statement
+            # rebind (state = step(state, ...) keeps the name live)
+            stmt = next(iter(module.enclosing(call, ast.stmt)), None)
+            rebound: Set[str] = set()
+            if isinstance(stmt, ast.Assign) and stmt.value is call:
+                rebound = {
+                    k for k in map(_expr_key, stmt.targets) if k is not None
+                }
+            for pos in donating[name]:
+                if pos >= len(call.args):
+                    continue
+                key = _expr_key(call.args[pos])
+                if key is not None and key not in rebound:
+                    dead[key] = call.lineno
+        if not dead:
+            return
+        # uses in source order, so a later rebind ends tracking exactly
+        # where it happens (a rebound name is a live buffer again)
+        uses = []
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            key = _expr_key(node)
+            if key in dead:
+                uses.append((node.lineno, node.col_offset, key, node))
+        for _, _, key, node in sorted(uses, key=lambda u: (u[0], u[1])):
+            if key not in dead or node.lineno <= dead[key]:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                dead.pop(key, None)
+                continue
+            if not isinstance(node.ctx, ast.Load):
+                continue
+            yield self.found(
+                module,
+                node,
+                f"{key} was donated on line {dead[key]} and read "
+                "here — its device buffer is deleted",
+            )
+            dead.pop(key, None)  # one finding per donated expr

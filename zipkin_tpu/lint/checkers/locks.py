@@ -1,0 +1,153 @@
+"""ZT04 — lock discipline across methods of a class.
+
+The r5 regression this pins the shape of: vocab-sidecar persistence
+raced concurrent writers — ``_archive_vocab_persisted`` and the sidecar
+``os.replace`` were updated under a lock on one path and lock-free on
+another, so a delayed writer could replace a NEWER sidecar with an older
+snapshot (fixed by ``_persist_lock``; previously pinned only by one
+behavioral test). "Fast Concurrent Data Sketches" (PAPERS.md) is the
+motivating frame: the ingest and read planes share mutable sketch state
+across threads, exactly where silent races are born.
+
+Rule: within one class, collect the lock attributes (``self.x =
+threading.Lock()/RLock()/Condition()`` — any assignment whose value is
+a ``threading.*`` constructor call). An instance attribute is
+*lock-associated* when some method writes it inside a ``with
+self.<lock>:`` block. Every OTHER write to that attribute (plain
+assignment, augmented assignment, or ``self.attr[...] = ...`` item
+write) outside any with-lock block — in any method except ``__init__``
+(construction precedes concurrency) — is a finding.
+
+"Callers hold the lock" helper methods are real and common (the
+aggregator's ``_flush_now``); they are exactly what the scoped pragma on
+the ``def`` line is for, with the justification naming the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from zipkin_tpu.lint.core import Checker, Module, register
+from zipkin_tpu.lint.taint import _root_name
+
+_FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self.X assigned from a threading.* lock constructor anywhere in
+    the class body."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        f = node.value.func
+        ctor = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if ctor not in _LOCK_CTORS:
+            continue
+        if isinstance(f, ast.Attribute) and _root_name(f) not in (
+            "threading",
+            "multiprocessing",
+            "mp",
+        ):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out.add(t.attr)
+    return out
+
+
+def _self_attr_write(target: ast.AST):
+    """'attr' when the assignment target writes self.attr or
+    self.attr[...] (an item write mutates the shared container)."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if (
+        isinstance(target, ast.Attribute)
+        and isinstance(target.value, ast.Name)
+        and target.value.id == "self"
+    ):
+        return target.attr
+    return None
+
+
+def _with_locks(module: Module, node: ast.AST, lock_attrs: Set[str]) -> bool:
+    """Is ``node`` lexically inside a ``with self.<lock>:`` block?"""
+    for w in module.enclosing(node, (ast.With, ast.AsyncWith)):
+        for item in w.items:
+            e = item.context_expr
+            # with self.lock: / with self._cv: / with self.lock, other:
+            if (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+                and e.attr in lock_attrs
+            ):
+                return True
+    return False
+
+
+@register
+class LockDiscipline(Checker):
+    rule = "ZT04"
+    severity = "error"
+    name = "lock-discipline"
+    doc = "attribute locked in one method, written lock-free in another"
+    hint = (
+        "take the same lock (or, if the caller provably holds it, "
+        "suppress on the def line naming the lock)"
+    )
+
+    def check(self, module: Module):
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(module, cls)
+
+    def _check_class(self, module: Module, cls: ast.ClassDef):
+        lock_attrs = _lock_attrs(cls)
+        if not lock_attrs:
+            return
+        # every write site: (attr, node, method, guarded?)
+        writes: List[Tuple[str, ast.AST, str, bool]] = []
+        for method in cls.body:
+            if not isinstance(method, _FUNC_KINDS):
+                continue
+            for node in ast.walk(method):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr_write(t)
+                    if attr is None or attr in lock_attrs:
+                        continue
+                    writes.append(
+                        (
+                            attr,
+                            node,
+                            method.name,
+                            _with_locks(module, node, lock_attrs),
+                        )
+                    )
+        guarded_attrs: Dict[str, Set[str]] = {}
+        for attr, _, meth, guarded in writes:
+            if guarded:
+                guarded_attrs.setdefault(attr, set()).add(meth)
+        for attr, node, meth, guarded in writes:
+            if guarded or attr not in guarded_attrs or meth == "__init__":
+                continue
+            lockers = ", ".join(sorted(guarded_attrs[attr]))
+            yield self.found(
+                module,
+                node,
+                f"{cls.name}.{attr} written lock-free in {meth}() but "
+                f"under a lock in {lockers}() — the r5 sidecar-race shape",
+            )

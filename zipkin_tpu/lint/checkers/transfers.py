@@ -1,0 +1,202 @@
+"""ZT01 / ZT02 — device→host transfer discipline.
+
+The r5 regression these rules pin: the dependencies read path made ~8
+separate device→host pulls per query (`np.asarray` per output array plus
+store-layer extras), amplifying the transport's fixed round trip into an
+822 ms quiesced wall for a 42.9 ms device program (VERDICT r5 weak #1).
+PR 1 collapsed the query path to ONE counted pull through
+``zipkin_tpu.readpack`` and pinned it with a one-file AST lint; these
+checkers apply the same invariant to the whole tree.
+
+- **ZT01**: a device-tainted value (see :mod:`zipkin_tpu.lint.taint`)
+  coerced to host via ``np.asarray``/``np.array``/``float()``/
+  ``.item()``/``.tolist()``, or any ``jax.device_get`` call, outside the
+  sanctioned chokepoint module (``zipkin_tpu/readpack.py``). Route pulls
+  through ``readpack.pull``/``readpack.device_get`` so ``hostTransfers``
+  counts them.
+- **ZT02**: the multi-pull *shape* — ≥2 host pulls in a single function
+  (each pays the transport round trip; pack on device and pull once), or
+  a ``return np.asarray(a), np.asarray(b), ...`` tuple anywhere (a
+  multi-pull read being born; subsumes the retired
+  tests/test_read_path_lint.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from zipkin_tpu.lint.core import Checker, Module, register
+from zipkin_tpu.lint.taint import FunctionTaint, _root_name
+
+# the sanctioned chokepoint: the ONE module allowed to device_get (its
+# counter is what makes transfers-per-query observable in production)
+CHOKEPOINT_PATH_SUFFIXES = ("zipkin_tpu/readpack.py",)
+
+_FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_chokepoint(module: Module) -> bool:
+    return module.rel.endswith(CHOKEPOINT_PATH_SUFFIXES)
+
+
+def _np_coercion(call: ast.Call):
+    """('asarray'|'array', arg) for np.asarray/np.array calls."""
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr in ("asarray", "array")
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "np"
+        and call.args
+    ):
+        return f.attr, call.args[0]
+    return None
+
+
+def _device_get_call(call: ast.Call):
+    """'jax' for jax.device_get(...) — an uncounted pull; 'chokepoint'
+    for readpack.device_get(...) or a bare device_get(...) (the counted
+    readpack chokepoint, imported or qualified); None otherwise."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "device_get":
+        return "jax" if _root_name(f) == "jax" else "chokepoint"
+    if isinstance(f, ast.Name) and f.id == "device_get":
+        return "chokepoint"
+    return None
+
+
+def _iter_functions(module: Module):
+    for node in ast.walk(module.tree):
+        if isinstance(node, _FUNC_KINDS):
+            yield node
+
+
+def _host_pulls(module: Module, fn: ast.AST, taint: FunctionTaint):
+    """Every (node, kind) in ``fn`` that moves device data to host:
+    tainted coercions, device_get calls, and ``self._pull``/
+    ``readpack.pull`` chokepoint calls (sanctioned, but each is still
+    one transfer — two of them in one method is still the r5 shape)."""
+    own = set()
+    for inner in ast.walk(fn):
+        if inner is not fn and isinstance(inner, _FUNC_KINDS):
+            own.update(ast.walk(inner))
+    for node in ast.walk(fn):
+        if node in own and node is not fn:
+            # nested defs get their own function entry (and their own
+            # taint scope) — don't double-count their pulls here
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        dg = _device_get_call(node)
+        if dg is not None:
+            yield node, (
+                "jax.device_get" if dg == "jax" else "chokepoint pull"
+            )
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("pull", "_pull"):
+            root = _root_name(f)
+            if root in ("self", "readpack", "agg") or f.attr == "_pull":
+                yield node, "chokepoint pull"
+            continue
+        coercion = _np_coercion(node)
+        if coercion is not None and taint.is_tainted(coercion[1]):
+            yield node, f"np.{coercion[0]} of a device value"
+            continue
+        if (
+            isinstance(f, ast.Name)
+            and f.id == "float"
+            and node.args
+            and taint.is_tainted(node.args[0])
+        ):
+            yield node, "float() of a device value"
+            continue
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("item", "tolist")
+            and not node.args
+            and taint.is_tainted(f.value)
+        ):
+            yield node, f".{f.attr}() of a device value"
+
+
+@register
+class HostTransferChokepoint(Checker):
+    rule = "ZT01"
+    severity = "error"
+    name = "host-transfer-chokepoint"
+    doc = "device→host coercion outside readpack"
+    hint = (
+        "route the pull through readpack.pull/readpack.device_get so "
+        "hostTransfers counts it (zipkin_tpu/readpack.py)"
+    )
+
+    def check(self, module: Module):
+        if _is_chokepoint(module):
+            return
+        if not module.imported_roots & {"jax", "jnp"}:
+            # a module that never touches jax holds no device values;
+            # np.asarray there is host-only input coercion
+            return
+        for fn in _iter_functions(module):
+            taint = FunctionTaint(fn)
+            for node, kind in _host_pulls(module, fn, taint):
+                if kind == "chokepoint pull":
+                    continue  # sanctioned (counted) — ZT02 counts them
+                yield self.found(
+                    module,
+                    node,
+                    f"{kind} in {fn.name}() — a device→host transfer "
+                    "outside the counted readpack chokepoint",
+                )
+
+
+@register
+class MultiPullShapes(Checker):
+    rule = "ZT02"
+    severity = "error"
+    name = "multi-pull-shapes"
+    doc = "≥2 host pulls per function / multi-asarray return tuples"
+    hint = (
+        "pack the program's outputs on device (readpack.pack) and pull "
+        "the one buffer once"
+    )
+
+    def check(self, module: Module):
+        if _is_chokepoint(module):
+            return
+        has_jax = bool(module.imported_roots & {"jax", "jnp"})
+        for fn in _iter_functions(module):
+            if has_jax:
+                taint = FunctionTaint(fn)
+                pulls = list(_host_pulls(module, fn, taint))
+                if len(pulls) >= 2:
+                    kinds = ", ".join(k for _, k in pulls)
+                    yield self.found(
+                        module,
+                        pulls[1][0],
+                        f"{fn.name}() makes {len(pulls)} host pulls "
+                        f"({kinds}) — each pays the transport round trip",
+                    )
+            # `return np.asarray(a), np.asarray(b)` is a multi-pull read
+            # being born whatever the taint analysis can prove — reject
+            # the shape itself (this subsumes the retired one-file lint)
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Return)
+                    and isinstance(node.value, ast.Tuple)
+                ):
+                    continue
+                n_asarray = sum(
+                    1
+                    for el in node.value.elts
+                    if isinstance(el, ast.Call) and _np_coercion(el)
+                )
+                if n_asarray >= 2:
+                    yield self.found(
+                        module,
+                        node,
+                        f"return tuple with {n_asarray} np.asarray "
+                        f"sections in {fn.name}() — one transfer per "
+                        "element",
+                    )

@@ -1,0 +1,124 @@
+"""ZT-lint CLI: ``python -m zipkin_tpu.lint [paths] [options]``.
+
+Exit code 0 = clean (after pragmas, --select/--ignore, and --baseline
+filtering); 1 = live findings or unparsable files. Designed to gate
+tier-1 (tests/test_lint_clean.py runs the same entry in-process).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from zipkin_tpu.lint.core import (
+    all_checkers,
+    iter_py_files,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+
+
+def _rule_set(spec):
+    if not spec:
+        return None
+    rules = {r.strip().upper() for r in spec.split(",") if r.strip()}
+    known = set(all_checkers())
+    unknown = rules - known
+    if unknown:
+        raise SystemExit(
+            f"zt-lint: unknown rule(s) {sorted(unknown)}; "
+            f"known: {sorted(known)}"
+        )
+    return rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m zipkin_tpu.lint",
+        description="ZT-lint: TPU-invariant static analysis for zipkin-tpu",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["zipkin_tpu"],
+        help="files or directories to lint (default: zipkin_tpu)",
+    )
+    p.add_argument(
+        "--select",
+        metavar="RULES",
+        help="comma-separated rule ids to run (e.g. ZT01,ZT04); "
+        "ZT00 always runs",
+    )
+    p.add_argument(
+        "--ignore",
+        metavar="RULES",
+        help="comma-separated rule ids to skip (ZT00 cannot be skipped)",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="JSON baseline of accepted findings to filter out",
+    )
+    p.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="write the current live findings as a baseline and exit 0",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    p.add_argument(
+        "-q", "--quiet", action="store_true", help="findings only, no summary"
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule, checker in all_checkers().items():
+            print(f"{rule}  {checker.name:28s} {checker.doc}")
+        return 0
+    baseline = load_baseline(args.baseline) if args.baseline else None
+    result = run_paths(
+        args.paths,
+        select=_rule_set(args.select),
+        ignore=_rule_set(args.ignore),
+        baseline=baseline,
+        root=Path.cwd(),
+    )
+    for err in result.errors:
+        print(f"ERROR {err}", file=sys.stderr)
+    if args.write_baseline:
+        # fingerprints need each finding's source-line context
+        entries = []
+        by_path = {}
+        for f in result.findings:
+            lines = by_path.setdefault(
+                f.path, Path(f.path).read_text().splitlines()
+            )
+            ctx = lines[f.line - 1].strip() if f.line <= len(lines) else ""
+            entries.append((f, ctx))
+        write_baseline(args.write_baseline, entries)
+        print(
+            f"wrote {len(entries)} finding(s) to baseline "
+            f"{args.write_baseline}"
+        )
+        return 0
+    for f in result.findings:
+        print(f.render())
+    if not args.quiet:
+        n_files = len(list(iter_py_files(args.paths)))
+        print(
+            f"zt-lint: {len(result.findings)} finding(s) in {n_files} "
+            f"file(s); {len(result.suppressed)} suppressed by pragma, "
+            f"{len(result.baselined)} baselined",
+            file=sys.stderr,
+        )
+    return result.exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,299 @@
+"""ZT-lint core: findings, checker registry, pragmas, baselines, runner.
+
+The framework is deliberately dependency-free (ast + tokenize from the
+stdlib) so it runs everywhere tier-1 runs. A checker is a class with a
+``rule`` id, a ``severity``, and a ``check(module)`` generator; the
+runner parses each file ONCE into a :class:`Module` (tree, parent map,
+comment pragmas) shared by every checker, then filters findings through
+inline suppressions and an optional baseline.
+
+Suppression pragma grammar (``# zt-lint: disable=ZT01[,ZT04] — reason``):
+
+- on the offending line: suppresses matching findings on that line;
+- on its own comment line: applies to the next code line (so long
+  justifications don't fight the line length), skipping blank and
+  further comment lines;
+- either placement on a ``def`` / ``class`` / ``with`` header line:
+  suppresses matching findings anywhere inside that statement's body;
+- a pragma with NO justification text after the rule list is itself a
+  finding (ZT00) — the acceptance bar is "suppressed WITH a reason",
+  and the linter enforces its own bar mechanically.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*zt-lint\s*:\s*disable\s*=\s*"
+    r"(?P<rules>ZT\d{2}(?:\s*,\s*ZT\d{2})*)"
+    r"(?P<reason>.*)$"
+)
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str            # "ZT01"
+    severity: str        # "error" | "warning"
+    path: str            # repo-relative posix path
+    line: int            # 1-based
+    col: int             # 0-based
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"  [fix: {self.hint}]"
+        return out
+
+    def fingerprint(self, context: str) -> Tuple[str, str, str]:
+        """Line-number-independent identity for baseline matching: the
+        stripped source line survives unrelated edits above it."""
+        return (self.rule, self.path, context)
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: Set[str]
+    reason: str
+
+
+class Module:
+    """One parsed source file, shared by every checker."""
+
+    def __init__(self, path: Path, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        # parent links let checkers walk OUT of a node (enclosing
+        # function / loop / with-block) without re-walking the tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.pragmas: List[Pragma] = list(_parse_pragmas(source))
+        # a pragma on its OWN comment line governs the next code line;
+        # one trailing a statement governs that statement's line
+        self._pragma_by_line: Dict[int, Pragma] = {}
+        for p in self.pragmas:
+            self._pragma_by_line[self._pragma_target(p.line)] = p
+        # top-level import names: "imports jax" gates device-taint rules
+        self.imported_roots: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imported_roots.add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                self.imported_roots.add(node.module.split(".")[0])
+
+    def _pragma_target(self, line: int) -> int:
+        text = self.lines[line - 1].lstrip() if line <= len(self.lines) else ""
+        if not text.startswith("#"):
+            return line  # trailing pragma: governs its own line
+        for nxt in range(line + 1, len(self.lines) + 1):
+            t = self.lines[nxt - 1].strip()
+            if t and not t.startswith("#"):
+                return nxt
+        return line  # pragma at EOF: nothing to govern
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def enclosing(self, node: ast.AST, kinds) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                yield cur
+            cur = self.parents.get(cur)
+
+    def suppressed(self, finding: Finding) -> Optional[Pragma]:
+        """The pragma suppressing this finding, if any: exact line, or a
+        scoped pragma on a def/class/with header whose span covers it."""
+        p = self._pragma_by_line.get(finding.line)
+        if p is not None and finding.rule in p.rules:
+            return p
+        for node in ast.walk(self.tree):
+            if not isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.With),
+            ):
+                continue
+            p = self._pragma_by_line.get(node.lineno)
+            if (
+                p is not None
+                and finding.rule in p.rules
+                and node.lineno <= finding.line <= (node.end_lineno or node.lineno)
+            ):
+                return p
+        return None
+
+
+def _parse_pragmas(source: str) -> Iterator[Pragma]:
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            reason = m.group("reason").strip(" \t-—:(").rstrip(")")
+            yield Pragma(line=tok.start[0], rules=rules, reason=reason)
+    except tokenize.TokenError:  # pragma: no cover - unparsable file
+        return
+
+
+class Checker:
+    """Base checker. Subclasses set ``rule``/``severity``/``hint`` and
+    implement :meth:`check`, yielding findings (use :meth:`found`)."""
+
+    rule: str = "ZT??"
+    severity: str = "error"
+    name: str = ""
+    doc: str = ""
+    hint: str = ""
+
+    def found(
+        self, module: Module, node: ast.AST, message: str, hint: str = ""
+    ) -> Finding:
+        return Finding(
+            rule=self.rule,
+            severity=self.severity,
+            path=module.rel,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            hint=hint or self.hint,
+        )
+
+    def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Checker] = {}
+
+
+def register(checker_cls):
+    """Class decorator: instantiate + index by rule id. Importing
+    ``zipkin_tpu.lint.checkers`` populates the registry."""
+    inst = checker_cls()
+    _REGISTRY[inst.rule] = inst
+    return checker_cls
+
+
+def all_checkers() -> Dict[str, Checker]:
+    from zipkin_tpu.lint import checkers  # noqa: F401 - registers on import
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+# -- baseline ------------------------------------------------------------
+
+
+def load_baseline(path) -> Set[Tuple[str, str, str]]:
+    """A baseline is the fingerprint set of known findings: matching
+    findings are reported as suppressed, so a tree with accepted debt
+    still gates NEW violations. Entries: {rule, path, context}."""
+    with open(path) as f:
+        data = json.load(f)
+    return {
+        (e["rule"], e["path"], e["context"]) for e in data.get("findings", ())
+    }
+
+
+def write_baseline(path, findings: Sequence[Tuple[Finding, str]]) -> None:
+    data = {
+        "findings": [
+            {"rule": f.rule, "path": f.path, "context": ctx}
+            for f, ctx in findings
+        ]
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+# -- runner --------------------------------------------------------------
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding] = field(default_factory=list)        # live
+    suppressed: List[Finding] = field(default_factory=list)      # pragma'd
+    baselined: List[Finding] = field(default_factory=list)       # in baseline
+    errors: List[str] = field(default_factory=list)              # parse errors
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings or self.errors else 0
+
+
+def iter_py_files(paths: Sequence, root: Optional[Path] = None) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def run_paths(
+    paths: Sequence,
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+    baseline: Optional[Set[Tuple[str, str, str]]] = None,
+    root: Optional[Path] = None,
+) -> RunResult:
+    """Lint every .py under ``paths``. ``select``/``ignore`` are rule-id
+    sets (select wins first, then ignore removes). ZT00 (suppression
+    hygiene) always runs: disabling the meta-rule would let reasonless
+    pragmas rot silently."""
+    checkers = all_checkers()
+    active = {
+        rule: c
+        for rule, c in checkers.items()
+        if (select is None or rule in select or rule == "ZT00")
+        and not (ignore and rule in ignore and rule != "ZT00")
+    }
+    root = Path(root) if root is not None else Path.cwd()
+    result = RunResult()
+    for path in iter_py_files(paths):
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        try:
+            module = Module(path, rel, path.read_text())
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            result.errors.append(f"{rel}: unparsable: {e}")
+            continue
+        for checker in active.values():
+            for finding in checker.check(module):
+                pragma = module.suppressed(finding)
+                if pragma is not None:
+                    result.suppressed.append(finding)
+                    continue
+                if baseline is not None:
+                    ctx = module.line_text(finding.line)
+                    if finding.fingerprint(ctx) in baseline:
+                        result.baselined.append(finding)
+                        continue
+                result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

@@ -1,0 +1,161 @@
+"""Device-value taint analysis (function-local, syntactic).
+
+ZT01/ZT02 must tell ``np.asarray(qs)`` (input coercion of a host list)
+apart from ``np.asarray(self._merge(self.state))`` (a device→host pull).
+There is no type information at lint time, so this module runs a small
+forward dataflow pass per function: an expression is *device-tainted*
+when it is built from
+
+- the aggregator state (any attribute chain rooted at ``self.state`` or
+  a bare ``state`` name — the pytree every compiled program takes),
+- a ``jax.*`` / ``jnp.*`` call (device arrays are born there), or
+- any call that RECEIVES a tainted argument (compiled programs are
+  opaque callables like ``self._merge``; what flows in device-flavored
+  comes out device-flavored),
+
+propagated through names: assignment / tuple-unpack / for-targets of a
+tainted value taint the bound names. Two passes over the statement list
+approximate a fixpoint (enough for loops that bind before use).
+
+Deliberately syntactic and local: a checker needs NO false negatives on
+the shapes that caused real regressions (multi-``np.asarray`` reads of
+program outputs) and LOW false positives on host-only numpy code — it
+does not chase taint across function boundaries.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+DEVICE_ROOT_MODULES = {"jax", "jnp"}
+STATE_ATTR = "state"
+
+# jax.* calls that return host-side METADATA (Device handles, counts),
+# not device arrays — np.asarray over these is not a transfer
+HOST_ONLY_JAX_ATTRS = {
+    "devices",
+    "local_devices",
+    "device_count",
+    "local_device_count",
+    "process_index",
+    "process_count",
+}
+
+
+def _root_name(node: ast.AST):
+    """The leftmost Name of an attribute/subscript/call chain, if any."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_state_chain(node: ast.AST) -> bool:
+    """True for ``self.state``, ``self.state.pend_pos``,
+    ``self.agg.state.hll``, ``state.hll``... — an attribute/subscript
+    chain with a ``.state`` segment (or a bare ``state`` name): the
+    aggregator pytree however it is reached."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute) and node.attr == STATE_ATTR:
+            return True
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == STATE_ATTR
+
+
+class FunctionTaint:
+    """Taint facts for one function body (nested defs included)."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.tainted_names: Set[str] = set()
+        body = getattr(fn, "body", [])
+        for _ in range(2):  # two passes ≈ fixpoint for name-level flow
+            for stmt in body:
+                self._visit_stmt(stmt)
+
+    # -- statement walk (assignments bind taint to names) ----------------
+
+    def _visit_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            if self.is_tainted(stmt.value):
+                for target in stmt.targets:
+                    self._taint_target(target)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None and self.is_tainted(stmt.value):
+                self._taint_target(stmt.target)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            if self.is_tainted(stmt.iter):
+                self._taint_target(stmt.target)
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None and self.is_tainted(
+                    item.context_expr
+                ):
+                    self._taint_target(item.optional_vars)
+            for s in stmt.body:
+                self._visit_stmt(s)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+        elif isinstance(stmt, ast.Try):
+            for s in (
+                stmt.body
+                + stmt.orelse
+                + stmt.finalbody
+                + [h for hs in stmt.handlers for h in hs.body]
+            ):
+                self._visit_stmt(s)
+        # nested defs keep their own scopes; don't descend
+
+    def _taint_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted_names.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._taint_target(el)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+        # attribute/subscript targets don't bind local names
+
+    # -- expression taint -------------------------------------------------
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted_names or node.id == STATE_ATTR
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            if _is_state_chain(node):
+                return True
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            root = _root_name(node.func)
+            if root in DEVICE_ROOT_MODULES:
+                return not (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in HOST_ONLY_JAX_ATTRS
+                )
+            if any(self.is_tainted(a) for a in node.args):
+                return True
+            if any(self.is_tainted(k.value) for k in node.keywords):
+                return True
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(el) for el in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, ast.NamedExpr):
+            return self.is_tainted(node.value)
+        return False

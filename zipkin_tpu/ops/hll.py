@@ -98,3 +98,58 @@ def _alpha(m: int) -> float:
 
 def standard_error(precision: int) -> float:
     return 1.04 / math.sqrt(1 << precision)
+
+
+# analytic |bias| of the raw estimator vs distinct count, measured by the
+# r5 register-law study (PROFILE_r05 §5, pinned by tests/test_ops_sketches
+# billion-scale test): 32-bit hash-space saturation drives it, so the
+# curve is a function of n (not of m) until the 4e9 hash boundary.
+BIAS_CURVE = (
+    (5.0e8, 0.004),
+    (1.0e9, 0.012),
+    (2.0e9, 0.044),
+    (4.0e9, 0.140),
+)
+
+
+def bias_fraction(n: float) -> float:
+    """|bias|/n of the raw estimator at ``n`` distinct values — log-log
+    interpolation of :data:`BIAS_CURVE`, clamped to the measured range."""
+    pts = BIAS_CURVE
+    if n <= pts[0][0]:
+        return pts[0][1]
+    if n >= pts[-1][0]:
+        return pts[-1][1]
+    for (n0, b0), (n1, b1) in zip(pts, pts[1:]):
+        if n <= n1:
+            t = (math.log(n) - math.log(n0)) / (math.log(n1) - math.log(n0))
+            return math.exp(
+                math.log(b0) + t * (math.log(b1) - math.log(b0))
+            )
+    return pts[-1][1]  # pragma: no cover - loop always returns
+
+
+def envelope_max(precision: int = 11) -> float:
+    """Largest cardinality the estimator serves inside its operating
+    envelope: where the analytic |bias| crosses HALF the 3·stderr noise
+    gate — past that, bias stops hiding inside the noise floor and
+    starts dominating the reported number. DERIVED from the measured
+    curve (inverse of :func:`bias_fraction` by the same log-log
+    segments), not hard-coded: ≈1.8e9 at p=11 (gate 3.45%). The bias is
+    hash-width-driven, so only the gate moves with ``precision``;
+    estimates beyond 4e9 are out of envelope at any precision (the
+    32-bit hash boundary — a 64-bit path, not a correction, past it).
+    """
+    gate = 1.5 * standard_error(precision)
+    pts = BIAS_CURVE
+    if gate <= pts[0][1]:
+        return pts[0][0]
+    for (n0, b0), (n1, b1) in zip(pts, pts[1:]):
+        if gate <= b1:
+            t = (math.log(gate) - math.log(b0)) / (
+                math.log(b1) - math.log(b0)
+            )
+            return math.exp(
+                math.log(n0) + t * (math.log(n1) - math.log(n0))
+            )
+    return pts[-1][0]

@@ -659,9 +659,10 @@ class ShardedAggregator:
     # the compiled program packs its outputs into a single ZPK1 buffer on
     # device (readpack.pack fused as the program's last stage) and
     # self._pull makes the one counted jax.device_get. Do not add bare
-    # np.asarray pulls here — tests/test_read_path_lint.py rejects them.
+    # np.asarray pulls here — ZT-lint rejects them (rules ZT01/ZT02,
+    # gated in tier-1 by tests/test_lint_clean.py).
 
-    def _pull(self, packed) -> list:
+    def _pull(self, packed) -> list:  # zt-lint: disable=ZT04 — every caller holds self.lock (contract in the docstring); read_stats has no separate lock
         """THE query-path device→host pull: one counted transfer, then
         zero-copy unpack of the ZPK1 sections (callers hold the lock)."""
         self.read_stats["host_transfers"] += 1
@@ -673,7 +674,7 @@ class ShardedAggregator:
             hist, hll_regs, counters = self._pull(self._merge(self.state))
             return hist, hll_regs, counters
 
-    def _link_context_cached(self):
+    def _link_context_cached(self):  # zt-lint: disable=ZT04 — callers (dependency_matrices, dependency_edges) hold self.lock around the cache check+fill
         """Device LinkContext for the current state (callers hold lock)."""
         version = self.write_version
         if self._ctx_cache[0] != version:
@@ -748,7 +749,7 @@ class ShardedAggregator:
             idx, calls, errors = self._pull(packed)
             return idx, calls, errors
 
-    def _flush_now(self) -> None:
+    def _flush_now(self) -> None:  # zt-lint: disable=ZT04 — callers hold self.lock; the state swap + mirror reset must be one critical section, which is why this helper is lock-free
         """Compact the pending digest buffer and reset the host mirror —
         the ONLY correct way to run the flush program (state swap and
         mirror reset are one invariant). Callers hold the lock.
@@ -784,6 +785,8 @@ class ShardedAggregator:
             self.ingest(cols)
         self.rollup_now()
         self.flush_now()
+        # zt-lint: disable=ZT06 — warm-up's whole point: retire every
+        # compile before a timed or serving window can start
         self.block_until_ready()
 
     def rollup_now(self) -> None:
@@ -870,7 +873,11 @@ class ShardedAggregator:
         """Re-derive the host pend mirror from device state (call after
         replacing ``self.state`` wholesale, e.g. snapshot restore)."""
         with self.lock:
-            self._pend_lanes = int(np.asarray(self.state.pend_pos).max())
+            # routed through the counted chokepoint: a restore-time pull
+            # is rare but should still show in the transfer ledger
+            self._pend_lanes = int(
+                readpack.device_get(self.state.pend_pos).max()
+            )
             # write distance since the last rollup is not recorded in
             # state; assume the worst so the next batch rolls up first
             self._lanes_since_rollup = self.config.rollup_segment

@@ -75,7 +75,8 @@ _transfers = 0
 def device_get(x) -> np.ndarray:
     """THE device→host pull for the query read path. Counts every call
     so transfers-per-query is observable; everything that serves a query
-    must come through here (pinned by tests/test_read_path_lint.py)."""
+    must come through here (pinned by ZT-lint rule ZT01 via
+    tests/test_lint_clean.py)."""
     global _transfers
     with _counter_lock:
         _transfers += 1

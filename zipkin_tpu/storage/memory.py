@@ -111,6 +111,8 @@ class InMemoryStorage(
 
         return Call.of(run)
 
+    # zt-lint: disable=ZT04 — the _locked suffix is the contract: the
+    # sole caller (accept's run closure) already holds self._lock
     def _evict_locked(self) -> None:
         """Drop whole traces, oldest first, until under the bound.
 

@@ -366,6 +366,8 @@ class SpanArchive:
                 self._seal_live()
                 self._enforce_retention()
 
+    # zt-lint: disable=ZT04 — called only from append_batch's critical
+    # section; self._lock is already held
     def _live_file(self):
         if self._live_fh is None:
             self._live_path = os.path.join(
@@ -376,6 +378,8 @@ class SpanArchive:
             self._live_bytes = os.path.getsize(self._live_path)
         return self._live_fh
 
+    # zt-lint: disable=ZT04 — every caller (append_batch, flush, close)
+    # holds self._lock around the seal
     def _seal_live(self) -> None:
         """Sort the live rows by low-64 trace id and write the sidecars;
         reopen the segment read-only as mmap."""
@@ -454,6 +458,8 @@ class SpanArchive:
 
     # -- recovery --------------------------------------------------------
 
+    # zt-lint: disable=ZT04 — constructor-time scan; no other thread can
+    # hold a reference to the archive yet
     def _recover(self) -> None:
         names = sorted(
             f for f in os.listdir(self.directory)

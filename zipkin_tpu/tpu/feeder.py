@@ -140,6 +140,8 @@ class AsyncIngestFeeder:
         self._dispatch_t.join()
         if self._error is not None:
             raise RuntimeError("feeder failed") from self._error
+        # zt-lint: disable=ZT06 — drain's contract IS the blocking sync:
+        # "wait for everything to land" includes the device queue
         self.store.agg.block_until_ready()
         return self._accepted
 

@@ -349,8 +349,13 @@ def cardinalities(state: AggState) -> jnp.ndarray:
     return hll.estimate(state.hll)
 
 
+@functools.lru_cache(maxsize=None)
 def jit_ingest(config: AggConfig):
-    """The compiled single-shard ingest step with state donation."""
+    """The compiled single-shard ingest step with state donation.
+
+    Cached per config (AggConfig is a hashable NamedTuple): callers may
+    treat this as cheap — repeat calls return the SAME jitted wrapper,
+    so its trace cache persists instead of recompiling per call."""
     return jax.jit(
         functools.partial(ingest_step, config), donate_argnums=(0,)
     )

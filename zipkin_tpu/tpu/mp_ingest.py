@@ -354,6 +354,9 @@ class MultiProcessIngester:
             )
         if self._dispatch_error is not None:
             raise RuntimeError("dispatcher died") from self._dispatch_error
+        # zt-lint: disable=ZT06 — drain's contract IS the blocking sync:
+        # "until every payload has reached the device" means retire the
+        # device queue, not just the dispatch threads
         self.store.agg.block_until_ready()
 
     def close(self) -> None:

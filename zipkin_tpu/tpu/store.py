@@ -31,6 +31,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from zipkin_tpu.internal.hex import epoch_minutes
+from zipkin_tpu.ops import hll
 from zipkin_tpu.model.span import DependencyLink, Span
 from zipkin_tpu.storage.memory import InMemoryStorage
 from zipkin_tpu.storage.spi import (
@@ -162,9 +163,11 @@ class TpuStorage(
         # fast-mode archive sampling: 1 in N traces keeps full raw spans
         # (0 disables). Trace-affine so sampled traces are COMPLETE.
         # Kept CONFIGURED even with the disk archive on: the sync fast
-        # path then skips RAM sampling (disk holds everything), but the
-        # MP tier's workers — which cannot feed the disk archive — still
-        # sample at this rate so MP-ingested traces stay readable.
+        # path then skips RAM sampling (disk holds everything), and the
+        # MP tier's workers ship raw records to the disk archive too
+        # (mp_ingest remaps worker-local vocab ids and appends) — their
+        # RAM sample at this rate then only backs autocompleteTags, or
+        # everything when no disk archive is configured.
         self._fast_archive_every = fast_archive_sample
         # interning id-space coherence: the C-side vocab (fast path) and
         # the Python vocab (object path) assign ids sequentially; any
@@ -174,6 +177,13 @@ class TpuStorage(
         # replace) so concurrent writers cannot reorder replaces
         self._persist_lock = threading.Lock()
         self._nvocab = None
+        # HLL operating envelope (r5 billion-scale study): cardinality
+        # estimates past this are bias-dominated, not noise-dominated.
+        # DERIVED from the measured bias curve at this precision, never
+        # hard-coded — see ops/hll.envelope_max (~1.8e9 at p=11).
+        self._hll_envelope_max = hll.envelope_max(self.config.hll_precision)
+        self._hll_envelope_exceeded = 0      # reads that saw such a row
+        self._hll_beyond_envelope_rows = 0   # rows beyond, at last read
         # read cache: device pulls (merged digest/sketches) keyed by the
         # write version, so repeated queries between writes cost nothing
         self._read_cache: dict = {}
@@ -200,6 +210,9 @@ class TpuStorage(
         # re-adds any post-snapshot tail (r4 review finding).
         self._load_archive_vocab()
 
+    # zt-lint: disable=ZT04 — runs once from __init__, before any other
+    # thread holds a reference to the store; _persist_archive_vocab's
+    # lock protects later concurrent writers, not construction
     def _load_archive_vocab(self) -> None:
         if self._archive_vocab_path is None:
             return
@@ -990,6 +1003,22 @@ class TpuStorage(
         return out
 
     def _cardinality_rows(self, est: np.ndarray) -> dict:
+        # operating-envelope guard: past envelope_max the estimator's
+        # bias exceeds half its 3σ noise gate, so the number reads as a
+        # lower bound, not an estimate — count it, gauge it, say it once
+        beyond = int((est > self._hll_envelope_max).sum())
+        if beyond:
+            self._hll_envelope_exceeded += 1
+            if not self._hll_beyond_envelope_rows:
+                logger.warning(
+                    "%d HLL row(s) estimate beyond the p=%d operating "
+                    "envelope (%.3g): bias now dominates noise; treat "
+                    "these cardinalities as lower bounds",
+                    beyond,
+                    self.config.hll_precision,
+                    self._hll_envelope_max,
+                )
+        self._hll_beyond_envelope_rows = beyond
         out = {"_global": float(est[self.config.global_hll_row])}
         for name in self.vocab.services.names:
             sid = self.vocab.services.get(name)
@@ -1035,6 +1064,10 @@ class TpuStorage(
             "hostTransfers": self.agg.read_stats["host_transfers"],
             "rolledOnlyReads": self.agg.read_stats["rolled_only_reads"],
             "ctxReads": self.agg.read_stats["ctx_reads"],
+            # HLL envelope guard: reads that saw a bias-dominated row /
+            # rows beyond at the last read (both 0 in healthy operation)
+            "hllEnvelopeExceeded": self._hll_envelope_exceeded,
+            "hllBeyondEnvelopeRows": self._hll_beyond_envelope_rows,
             "serviceVocabOverflow": self.vocab.services.overflow,
             "keyVocabOverflow": self.vocab._overflow,
             # the fast path interns in C; rejected entries never reach
@@ -1049,6 +1082,8 @@ class TpuStorage(
 
     def check(self) -> CheckResult:
         try:
+            # zt-lint: disable=ZT06 — the health check's contract is to
+            # prove the device round-trips; blocking IS the probe
             self.agg.block_until_ready()
             return CheckResult.OK
         except Exception as e:  # pragma: no cover - device failure path
