@@ -1,0 +1,244 @@
+"""Randomized-crashpoint SIGKILL soak: the chaos harness at real scale.
+
+tests/test_chaos_recovery.py proves per-site recovery in-process with
+raised crashpoints. This driver does it with honest SIGKILLs: each
+cycle spawns a CHILD ingest process with ``ZT_CRASHPOINT=<site>:<nth>``
+armed (zipkin_tpu/faults.py), the child kills itself AT a randomized
+durability-critical instant (torn WAL record, half-committed snapshot
+pair, torn archive frame), and the parent boots a fresh store from the
+same dirs and asserts BIT-IDENTICAL counter/link/sketch parity against
+an uninterrupted oracle fed the recovered batch prefix.
+
+The batch feed is deterministic by index (seeded), so "recovered spans"
+identifies exactly which prefix the oracle must ingest; the child
+re-feeds anything unacked, which is just the client retrying.
+
+Run from the repo root: ``python -m benchmarks.chaos_soak``
+(CHAOS_CYCLES (default 20), CHAOS_SPANS_PER_BATCH, CHAOS_SNAP_EVERY,
+CHAOS_PREFILL_BATCHES — raise it for the 20M+-span measured-restore
+run, CHAOS_SMALL=0 for the full-size chip config, CHAOS_SEED).
+Reports the boot-time restore gauges (restoreMs / walReplayBatches /
+walReplayMs) for every recovery boot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+SMALL_CFG = dict(
+    max_services=64, max_keys=256, hll_precision=8, digest_centroids=16,
+    digest_buffer=1 << 14, ring_capacity=1 << 14, link_buckets=4,
+    hist_slices=2,
+)
+
+_CHILD = r"""
+import json, os, sys
+from benchmarks.chaos_soak import feed_batch, make_store
+
+state_dir = sys.argv[1]
+cfg_json = sys.argv[2]
+per = int(sys.argv[3])
+snap_every = int(sys.argv[4])
+seed = int(sys.argv[5])
+store = make_store(state_dir, cfg_json, archive=True)
+k = store.ingest_counters()["spans"] // per  # resume at the durable prefix
+i = k
+while True:
+    feed_batch(store, i, per, seed)
+    i += 1
+    # acked = the ingest call returned; its WAL record is on disk
+    print(f"ACKED {store.ingest_counters()['spans']}", flush=True)
+    if i % snap_every == 0:
+        store.snapshot()
+        print("SNAP", flush=True)
+"""
+
+
+def make_store(state_dir, cfg_json, archive=False):
+    from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    cfg = AggConfig(**json.loads(cfg_json)) if cfg_json != "null" else None
+    return TpuStorage(
+        batch_size=8192, config=cfg, num_devices=1,
+        checkpoint_dir=os.path.join(state_dir, "ckpt"),
+        wal_dir=os.path.join(state_dir, "wal"),
+        archive_dir=os.path.join(state_dir, "archive") if archive else None,
+    )
+
+
+def payload_for(i, per, seed):
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.model.json_v2 import encode_span_list
+
+    return encode_span_list(
+        lots_of_spans(per, seed=seed + i, services=32, span_names=64)
+    )
+
+
+def feed_batch(store, i, per, seed):
+    """One deterministic batch by index — the child and the oracle MUST
+    ride the identical path for bit-identical vocab interning order."""
+    payload = payload_for(i, per, seed)
+    if store.ingest_json_fast(payload) is None:
+        from zipkin_tpu.model import codec
+
+        store.accept(codec.decode_spans(payload)).execute()
+
+
+def parity_errors(a, b):
+    errs = []
+    if a.agg.host_counters != b.agg.host_counters:
+        errs.append("host_counters")
+    hist_a, hll_a, _ = a.agg.merged_sketches()
+    hist_b, hll_b, _ = b.agg.merged_sketches()
+    if not np.array_equal(hist_a, hist_b):
+        errs.append("latency_hist")
+    if not np.array_equal(hll_a, hll_b):
+        errs.append("hll")
+    ca, ea = a.agg.dependency_matrices(0, 1 << 31)
+    cb, eb = b.agg.dependency_matrices(0, 1 << 31)
+    if not (np.array_equal(ca, cb) and np.array_equal(ea, eb)):
+        errs.append("links")
+    if a.trace_cardinalities() != b.trace_cardinalities():
+        errs.append("cardinalities")
+    return errs
+
+
+def run_child(state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s):
+    env = dict(os.environ, ZT_CRASHPOINT=f"{site}:{nth}")
+    env.pop("ZT_CRASHPOINT_ACTION", None)  # default: SIGKILL
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, state_dir, cfg_json, str(per),
+         str(snap_every), str(seed)],
+        stdout=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    acks = [0]
+
+    def reader():
+        for line in child.stdout:
+            if line.startswith("ACKED "):
+                acks[0] = int(line.split()[1])
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.monotonic() + timeout_s
+    timed_out = False
+    while child.poll() is None:
+        if time.monotonic() > deadline:
+            timed_out = True
+            os.kill(child.pid, signal.SIGKILL)  # backstop kill
+            break
+        time.sleep(0.1)
+    child.wait()
+    t.join(timeout=10)
+    return acks[0], child.returncode, timed_out
+
+
+def main() -> None:
+    from zipkin_tpu import faults
+
+    cycles = int(os.environ.get("CHAOS_CYCLES", 20))
+    per = int(os.environ.get("CHAOS_SPANS_PER_BATCH", 2048))
+    snap_every = int(os.environ.get("CHAOS_SNAP_EVERY", 3))
+    prefill = int(os.environ.get("CHAOS_PREFILL_BATCHES", 0))
+    small = os.environ.get("CHAOS_SMALL", "1") not in ("0", "false")
+    seed = int(os.environ.get("CHAOS_SEED", 9000))
+    timeout_s = float(os.environ.get("CHAOS_CHILD_TIMEOUT_S", 180))
+    cfg_json = json.dumps(SMALL_CFG) if small else "null"
+    state_dir = tempfile.mkdtemp(prefix="chaos_soak_")
+    rng = random.Random(seed)
+
+    oracle = None  # built lazily so the child compiles first
+    oracle_k = 0
+    committed = 0
+    report = {"artifact": "chaos_soak", "cycles": [], "per_batch": per}
+    ok = True
+    hits = {s: 0 for s in faults.SITES}
+    last_restore = {}
+
+    if prefill:
+        # measured-restore mode: make the first recovery boot restore a
+        # real snapshot AND replay a deep WAL tail (snapshot at the
+        # midpoint, second half left uncovered), so cycle 0's gauges are
+        # an honest restore cost at prefill*per spans
+        pre = make_store(state_dir, cfg_json, archive=True)
+        for i in range(prefill):
+            feed_batch(pre, i, per, seed)
+            if i == prefill // 2:
+                pre.snapshot()
+        del pre  # crash idiom: everything acked is already durable
+
+    for cycle in range(cycles):
+        site = faults.SITES[cycle % len(faults.SITES)]
+        nth = rng.randint(1, 3)
+        acked, rc, timed_out = run_child(
+            state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s
+        )
+
+        # recovery boot in the parent: fresh process-independent state
+        revived = make_store(state_dir, cfg_json, archive=True)
+        recovered = revived.ingest_counters()["spans"]
+        last_restore = dict(revived.restore_stats)
+        cycle_report = {
+            "site": site, "nth": nth, "acked": acked,
+            "recovered": recovered, "child_rc": rc,
+            "timed_out": timed_out, **last_restore,
+        }
+        errs = []
+        if not timed_out and rc not in (-signal.SIGKILL, 128 + signal.SIGKILL):
+            # the crashpoint must be what killed it — a clean exit or a
+            # Python traceback is a harness bug, not a chaos result
+            errs.append(f"child died abnormally (rc={rc})")
+        if recovered % per or recovered < committed * per:
+            errs.append("recovered count not a batch prefix")
+        if not (acked <= recovered <= acked + per):
+            errs.append("acked bound violated")
+        k = recovered // per
+        if oracle is None:
+            oracle = make_store(
+                os.path.join(state_dir, "oracle"), cfg_json
+            )
+        while oracle_k < k:
+            feed_batch(oracle, oracle_k, per, seed)
+            oracle_k += 1
+        errs += parity_errors(oracle, revived)
+        committed = k
+        revived.close()
+        cycle_report["parity_errors"] = errs
+        report["cycles"].append(cycle_report)
+        hits[site] += 1
+        if errs:
+            ok = False
+        print(json.dumps(cycle_report), flush=True)
+
+    report.update(
+        bit_identical=ok,
+        sites_hit=hits,
+        recovered_spans=committed * per,
+        # the acceptance gauge set: cost of the LAST recovery boot
+        restore_ms=last_restore.get("restoreMs"),
+        wal_replay_batches=last_restore.get("walReplayBatches"),
+        wal_replay_ms=last_restore.get("walReplayMs"),
+    )
+    print(json.dumps(report), flush=True)
+    if ok:
+        shutil.rmtree(state_dir, ignore_errors=True)  # keep only on failure
+        sys.exit(0)
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

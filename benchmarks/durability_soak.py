@@ -148,6 +148,10 @@ def main() -> None:
                 "recovered_spans": recovered,
                 "bound_ok": ok,
                 "recovery_s": round(recovery_s, 1),
+                # boot-time restore gauges (also on /metrics+/prometheus)
+                "restore_ms": revived.restore_stats["restoreMs"],
+                "wal_replay_batches": revived.restore_stats["walReplayBatches"],
+                "wal_replay_ms": revived.restore_stats["walReplayMs"],
                 "links_after_recovery": len(links),
                 "snapshot_interval_s": snap_s,
             }

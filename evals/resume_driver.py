@@ -1,0 +1,82 @@
+"""Relaunch loop for the crash-resumable config4 flagship run (ISSUE 3).
+
+Runs ``evals.run_configs config4`` with ``EVAL_RESUME_DIR`` set, so each
+window ingests under a ResumeSupervisor: a degraded window (wire rate
+collapsing against the rolling baseline) or the per-window deadline
+drains, snapshots, records ``eval_cursor.json`` and exits EX_RESTART
+(75). This driver relaunches on 75 — the next window restores the
+snapshot, replays the WAL tail and resumes batch indexing from the
+cursor, so DISTINCT trace ids and span counts accumulate across windows
+toward EVAL_REPLAY_SPANS (1e9 at flagship scale). The per-window
+deadline default guarantees at least one REAL mid-run restore even on a
+backend that never degrades.
+
+Run: python -m evals.resume_driver
+Env: EVAL_RESUME_DIR (default ./eval_resume_state),
+     EVAL_WINDOW_DEADLINE_S (default 600 — set it above the expected
+     full-run wall time to make restores degraded-only),
+     EVAL_MAX_WINDOWS (default 64), EVAL_REQUIRE_RESTORE (default 1),
+     plus everything config4 honors (EVAL_REPLAY_SPANS, EVAL_SMALL, ...).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+EX_RESTART = 75
+
+
+def main() -> None:
+    resume_dir = os.path.abspath(
+        os.environ.get("EVAL_RESUME_DIR") or "eval_resume_state"
+    )
+    os.makedirs(resume_dir, exist_ok=True)
+    env = dict(os.environ, EVAL_RESUME_DIR=resume_dir)
+    env.setdefault("EVAL_WINDOW_DEADLINE_S", "600")
+    max_windows = int(os.environ.get("EVAL_MAX_WINDOWS", 64))
+    require_restore = os.environ.get("EVAL_REQUIRE_RESTORE", "1") != "0"
+
+    windows = 0
+    restores = 0
+    rc = EX_RESTART
+    t0 = time.monotonic()
+    while windows < max_windows:
+        rc = subprocess.call(
+            [sys.executable, "-m", "evals.run_configs", "config4"], env=env
+        )
+        windows += 1
+        if rc == 0:
+            break
+        if rc != EX_RESTART:
+            print(json.dumps({
+                "artifact": "config4_resume_driver", "completed": False,
+                "windows": windows, "failed_rc": rc,
+            }), flush=True)
+            sys.exit(rc)
+        restores += 1  # the NEXT launch performs a real restore
+
+    cursor = {}
+    cursor_path = os.path.join(resume_dir, "eval_cursor.json")
+    if os.path.exists(cursor_path):
+        cursor = json.load(open(cursor_path))
+    completed = rc == 0
+    ok = completed and (restores >= 1 or not require_restore)
+    print(json.dumps({
+        "artifact": "config4_resume_driver",
+        "completed": completed,
+        "windows": windows,
+        "restores": restores,
+        "cumulative_spans": cursor.get("spans"),
+        "distinct_trace_ids": cursor.get("distinct_traces"),
+        "wall_s": round(time.monotonic() - t0, 1),
+        "passed": ok,
+    }), flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

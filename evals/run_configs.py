@@ -463,6 +463,24 @@ def config4() -> bool:
     # reporting disk churn — the 1B-scale gate requires WAL/snapshot
     # growth bounded, not just throughput (VERDICT r3 order 3)
     durable_dir = os.environ.get("EVAL_REPLAY_DURABLE")
+    # EVAL_RESUME_DIR=<dir> (ISSUE 3): crash-resumable flagship run. The
+    # store boots by restoring <dir>/snap + replaying <dir>/wal, batch
+    # indexing resumes from the eval_cursor.json sidecar (trace-id
+    # prefixes stay disjoint across windows), and a ResumeSupervisor
+    # watches the wire rate — a degraded window (or the per-window
+    # deadline EVAL_WINDOW_DEADLINE_S) drains, snapshots, records the
+    # cursor and exits EX_RESTART(75) for evals/resume_driver.py to
+    # relaunch. Span counts ACCUMULATE across windows toward the target.
+    resume_dir = os.environ.get("EVAL_RESUME_DIR")
+    if resume_dir:
+        durable_dir = resume_dir
+    cursor_path = (
+        os.path.join(resume_dir, "eval_cursor.json") if resume_dir else None
+    )
+    cursor = {"next_batch": 0, "distinct_traces": 0, "windows": 0}
+    if cursor_path and os.path.exists(cursor_path):
+        cursor.update(json.load(open(cursor_path)))
+    it0 = cursor["next_batch"]
     snap_every = int(os.environ.get("EVAL_SNAPSHOT_EVERY_BATCHES", 448))
     # disk archive on the ingest path (r5): default ON at full scale,
     # budget-bounded so retention churns live; EVAL_ARCHIVE_DIR=off
@@ -550,6 +568,7 @@ def config4() -> bool:
     end_ts = max(s.timestamp for s in corpus if s.timestamp) // 1000 + 3_600_000
     lookback = 1000 * 86_400_000
     fast = native.available()
+    resumed_spans = store.ingest_counters()["spans"] if resume_dir else 0
     if fast:
         # warm EVERY program the stream can hit (all fused step variants
         # + flush + rollup) — first compiles through the remote-compile
@@ -557,7 +576,7 @@ def config4() -> bool:
         store.warm(payload_t)
         sent = store.ingest_counters()["spans"]
     else:  # pragma: no cover - no C toolchain
-        sent = 0
+        sent = resumed_spans
 
     KINDS = (
         "dependencies", "dependencies_fresh", "percentiles", "windowed",
@@ -624,14 +643,29 @@ def config4() -> bool:
     probes: list = []
     probes_incomplete = 0
     acked: list = []  # patched probe tids, oldest first (bounded)
-    distinct_traces = 0
+    distinct_traces = cursor["distinct_traces"]
+    sup = None
+    tripped = None
+    if resume_dir:
+        from zipkin_tpu.runtime.supervisor import ResumeSupervisor
+
+        sup = ResumeSupervisor(
+            store,
+            window_s=float(os.environ.get("EVAL_SUP_WINDOW_S", 5.0)),
+            degraded_fraction=float(
+                os.environ.get("EVAL_DEGRADED_FRACTION", 0.25)
+            ),
+            degraded_windows=int(os.environ.get("EVAL_DEGRADED_WINDOWS", 3)),
+            deadline_s=float(os.environ.get("EVAL_WINDOW_DEADLINE_S", 0) or 0),
+        )
+        sup.observe(sent)  # establishes the window clock
     start = time.perf_counter()
     while sent < total:
         if deadline_s and time.perf_counter() - start > deadline_s:
             deadline_hit = True
             break
         if fast:
-            payload, tid = patched(batches)
+            payload, tid = patched(it0 + batches)
             n, _ = store.ingest_json_fast(payload)
             acked.append(tid)
             distinct_traces += distinct_per_batch
@@ -641,6 +675,10 @@ def config4() -> bool:
             n = len(chunk)
         sent += n
         batches += 1
+        if sup is not None:
+            tripped = sup.observe(sent)
+            if tripped:
+                break
         if sync_every and batches % sync_every == 0:
             # bound the in-flight dispatch queue (see docstring)
             store.agg.block_until_ready()
@@ -672,6 +710,35 @@ def config4() -> bool:
                 ),
             }), file=sys.stderr, flush=True)
     store.agg.block_until_ready()
+
+    def _write_cursor():
+        tmp = cursor_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "next_batch": it0 + batches,
+                "distinct_traces": distinct_traces,
+                "windows": cursor["windows"] + 1,
+                "spans": sent,
+            }, f)
+        os.replace(tmp, cursor_path)
+
+    if tripped:
+        # degraded/deadline window: drain + exit snapshot, record the
+        # cursor, and exit restartable — the relaunch restores from the
+        # snapshot and the cumulative span count keeps climbing
+        from zipkin_tpu.runtime.supervisor import EX_RESTART
+
+        sup.finalize()
+        _write_cursor()
+        _emit(config="config4", window=cursor["windows"] + 1,
+              window_tripped=tripped, window_exit=EX_RESTART,
+              resumed_from_spans=resumed_spans, spans=sent,
+              target_spans=total, supervisor=sup.stats(),
+              restore=dict(getattr(store, "restore_stats", {})),
+              window_spans_per_sec=round(
+                  (sent - warm) / max(time.perf_counter() - start, 1e-9)))
+        sys.exit(EX_RESTART)
+
     if not lat["dependencies"]:
         query_round(lat)  # never skip the query half at small smoke scales
     elapsed = time.perf_counter() - start
@@ -907,8 +974,15 @@ def config4() -> bool:
         archive_stats = {
             k: v for k, v in counters.items() if k.startswith("archive")
         }
+    if resume_dir:
+        _write_cursor()
     _emit(config="config4", passed=bool(ok and slo_ok), spans=sent,
           target_spans=total, wall_deadline_hit=deadline_hit,
+          window=cursor["windows"] + 1 if resume_dir else None,
+          resumed_from_spans=resumed_spans if resume_dir else None,
+          restore=dict(getattr(store, "restore_stats", {}))
+          if resume_dir else None,
+          supervisor=sup.stats() if sup else None,
           fast_path=fast,
           sustained_spans_per_sec=round((sent - warm) / elapsed),
           distinct_identity_gate=hll_gate,

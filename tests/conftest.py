@@ -27,6 +27,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m "not slow"` (ROADMAP.md); the chaos/soak tier is
+    # opt-in. Registered here because the repo has no pytest.ini.
+    config.addinivalue_line(
+        "markers",
+        "slow: long randomized chaos/soak tests, excluded from tier-1",
+    )
+
+
 def pytest_sessionstart(session):
     assert jax.default_backend() == "cpu", jax.default_backend()
     assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,228 @@
+"""Fault-injection chaos recovery (ISSUE 3 tentpole).
+
+Each cycle arms one named crashpoint (zipkin_tpu.faults.SITES — the
+exact instants where a crash tears on-disk state hardest), crashes the
+ingesting store AT it, boots a fresh store from the same dirs, and
+asserts bit-identical counter/link/sketch parity against an
+uninterrupted oracle fed the recovered batch prefix.
+
+Crash simulation uses action="raise": ``CrashpointTriggered``
+propagates out of the write path and the store object is abandoned —
+the same HBM-is-gone idiom as tests/test_wal.py, with the addition
+that the armed site flushes its partial write first so the on-disk
+tear is exactly what a SIGKILL after a real flush would leave. The
+SIGKILL-subprocess variant of this harness is benchmarks/chaos_soak.py.
+
+Tier-1 runs the deterministic single-site tests; the randomized
+multi-site soak (>=20 kill/restart cycles) is marked slow.
+"""
+
+from __future__ import annotations
+
+import glob
+import random
+
+import pytest
+
+from tests.fixtures import lots_of_spans
+from tests.test_wal import CFG, assert_query_parity, batches, make
+from zipkin_tpu import faults
+from zipkin_tpu.storage.tpu import TpuStorage
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+# -- registry basics -----------------------------------------------------
+
+
+def test_crashpoint_registry():
+    assert faults.armed_site() is None
+    faults.crashpoint("wal.append.mid")  # disarmed: no-op
+    with pytest.raises(ValueError, match="unknown crashpoint site"):
+        faults.arm("no.such.site")
+    faults.arm("wal.append.mid", nth=2, action="raise")
+    assert faults.is_armed("wal.append.mid")
+    faults.crashpoint("snapshot.post_meta")  # different site: no-op
+    faults.crashpoint("wal.append.mid")  # pass 1 of 2: survives
+    with pytest.raises(faults.CrashpointTriggered):
+        faults.crashpoint("wal.append.mid")
+    assert faults.armed_site() is None  # one-shot: self-disarmed
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "archive.mid_segment:3")
+    monkeypatch.setenv(faults.ENV_ACTION, "raise")
+    faults._arm_from_env()
+    assert faults.is_armed("archive.mid_segment")
+    faults.disarm()
+    monkeypatch.setenv(faults.ENV_VAR, "bogus.site")
+    faults._arm_from_env()  # must not raise: a typo cannot brick boot
+    assert faults.armed_site() is None
+
+
+# -- deterministic sites (tier-1) ----------------------------------------
+
+
+def test_crash_mid_wal_append_recovers_to_parity(tmp_path):
+    """Torn WAL record (header+meta on disk, payload missing): the
+    crashed batch was never acked, everything before it replays."""
+    bs = batches(5)
+    victim = make(tmp_path)
+    for spans in bs[:3]:
+        victim.accept(spans).execute()
+    faults.arm("wal.append.mid", action="raise")
+    with pytest.raises(faults.CrashpointTriggered):
+        victim.accept(bs[3]).execute()
+    del victim  # crash: HBM gone, torn record on disk
+
+    revived = make(tmp_path)
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for spans in bs[:3]:
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, revived)
+    # the revived store is fully usable: the lost batch's client retry
+    # and further traffic land normally and stay durable
+    revived.accept(bs[3]).execute()
+    revived.accept(bs[4]).execute()
+    del revived
+    revived2 = make(tmp_path)
+    for spans in bs[3:]:
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, revived2)
+
+
+def test_crash_between_snapshot_state_and_meta_keeps_old_pair(tmp_path):
+    """snapshot.post_state: the new state .npz is renamed in but
+    meta.json still describes the previous snapshot. The commit
+    protocol (meta.json names its state file) must restore the OLD
+    complete pair and replay the longer WAL tail — pairing new state
+    with old meta would double-replay into it."""
+    bs = batches(5)
+    victim = make(tmp_path)
+    for spans in bs[:2]:
+        victim.accept(spans).execute()
+    victim.snapshot()  # a complete old pair exists
+    for spans in bs[2:4]:
+        victim.accept(spans).execute()
+    faults.arm("snapshot.post_state", action="raise")
+    with pytest.raises(faults.CrashpointTriggered):
+        victim.snapshot()
+    del victim
+
+    revived = make(tmp_path)
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for spans in bs[:4]:
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, revived)
+
+
+def test_crash_after_snapshot_meta_before_truncate(tmp_path):
+    """snapshot.post_meta: the snapshot is durable but covered WAL
+    segments were not truncated. Replay must skip the covered records
+    (seq <= wal_seq) instead of double-applying them."""
+    bs = batches(4)
+    victim = make(tmp_path)
+    for spans in bs:
+        victim.accept(spans).execute()
+    faults.arm("snapshot.post_meta", action="raise")
+    with pytest.raises(faults.CrashpointTriggered):
+        victim.snapshot()
+    del victim
+
+    revived = make(tmp_path)
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for spans in bs:
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, revived)
+
+
+# -- randomized multi-site soak (slow) -----------------------------------
+
+
+def _make_chaos(root, oracle=False):
+    sub = "oracle" if oracle else "state"
+    return TpuStorage(
+        config=CFG, num_devices=1, batch_size=512,
+        checkpoint_dir=None if oracle else str(root / sub / "ckpt"),
+        wal_dir=None if oracle else str(root / sub / "wal"),
+        archive_dir=None if oracle else str(root / sub / "archive"),
+    )
+
+
+@pytest.mark.slow
+def test_randomized_chaos_cycles(tmp_path):
+    """>=20 randomized crash/restart cycles across ALL registered
+    sites; after every crash the revived store must be bit-identical to
+    an oracle fed exactly the recovered batch prefix."""
+    rng = random.Random(0xC4A05)
+    per = 300
+    feed = [
+        lots_of_spans(per, seed=900 + i, services=8, span_names=12)
+        for i in range(120)
+    ]
+    oracle = _make_chaos(tmp_path, oracle=True)
+    oracle_k = 0
+    committed = 0  # batches proven durable so far
+    cursor = 0  # next feed index (re-feeds any unacked/lost batch)
+    cycles = 0
+    target = 21
+    hits = {s: 0 for s in faults.SITES}
+
+    while cycles < target:
+        site = faults.SITES[cycles % len(faults.SITES)]
+        victim = _make_chaos(tmp_path)
+
+        # boot parity: recovery must reproduce exactly a batch prefix
+        recovered = victim.agg.host_counters["spans"]
+        assert recovered % per == 0, (site, recovered)
+        k = recovered // per
+        assert k >= committed, f"{site}: lost acked batches ({k}<{committed})"
+        while oracle_k < k:
+            oracle.accept(feed[oracle_k]).execute()
+            oracle_k += 1
+        assert_query_parity(oracle, victim)
+        committed = k
+        cursor = k  # the client retries anything unacked
+
+        crashed = False
+        if site.startswith("snapshot."):
+            for _ in range(rng.randint(1, 3)):
+                victim.accept(feed[cursor]).execute()
+                cursor += 1
+            faults.arm(site, nth=1, action="raise")
+            with pytest.raises(faults.CrashpointTriggered):
+                victim.snapshot()
+            crashed = True
+        else:
+            faults.arm(site, nth=rng.randint(1, 3), action="raise")
+            try:
+                while cursor < len(feed):
+                    victim.accept(feed[cursor]).execute()
+                    cursor += 1
+                    if rng.random() < 0.3:
+                        victim.snapshot()
+            except faults.CrashpointTriggered:
+                crashed = True
+        assert crashed, site
+        faults.disarm()
+        del victim
+        hits[site] += 1
+        cycles += 1
+
+    assert cycles >= 20
+    assert all(n >= 4 for n in hits.values()), hits
+
+    # final boot: everything ever acked is present and queryable
+    final = _make_chaos(tmp_path)
+    k = final.agg.host_counters["spans"] // per
+    while oracle_k < k:
+        oracle.accept(feed[oracle_k]).execute()
+        oracle_k += 1
+    assert_query_parity(oracle, final)
+    # the disk archive recovered alongside (torn frames truncated)
+    assert final._disk is not None
+    assert final._disk.spans_written >= 0

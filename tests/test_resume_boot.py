@@ -1,0 +1,70 @@
+"""TPU_RESUME_DIR boot wiring + restore gauges on /metrics and
+/prometheus (ISSUE 3 end-to-end restore path)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from tests.test_wal import batches, make
+from zipkin_tpu.server.config import ServerConfig
+
+
+def test_resume_dir_derives_durable_paths(monkeypatch, tmp_path):
+    root = str(tmp_path / "state")
+    monkeypatch.setenv("TPU_RESUME_DIR", root)
+    for var in ("TPU_CHECKPOINT_DIR", "TPU_WAL_DIR", "TPU_ARCHIVE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = ServerConfig.from_env()
+    assert cfg.tpu_resume_dir == root
+    assert cfg.tpu_checkpoint_dir.endswith("/snap")
+    assert cfg.tpu_wal_dir.endswith("/wal")
+    assert cfg.tpu_archive_dir.endswith("/archive")
+    for path in (cfg.tpu_checkpoint_dir, cfg.tpu_wal_dir, cfg.tpu_archive_dir):
+        assert path.startswith(root)
+
+
+def test_explicit_dirs_override_resume_dir(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_RESUME_DIR", str(tmp_path / "state"))
+    monkeypatch.setenv("TPU_WAL_DIR", str(tmp_path / "elsewhere-wal"))
+    monkeypatch.setenv("TPU_ARCHIVE_DIR", "off")
+    monkeypatch.delenv("TPU_CHECKPOINT_DIR", raising=False)
+    cfg = ServerConfig.from_env()
+    assert cfg.tpu_wal_dir == str(tmp_path / "elsewhere-wal")
+    assert cfg.tpu_archive_dir is None
+    assert cfg.tpu_checkpoint_dir.endswith("/snap")
+
+
+def test_restore_gauges_on_metrics_and_prometheus(tmp_path):
+    from zipkin_tpu.server.app import ZipkinServer
+
+    bs = batches(3)
+    first = make(tmp_path)
+    for spans in bs:
+        first.accept(spans).execute()
+    del first  # crash without a snapshot: boot must replay the WAL
+
+    resumed = make(tmp_path)
+    assert resumed.restore_stats["walReplayBatches"] == len(bs)
+    assert resumed.restore_stats["walReplayMs"] > 0
+
+    server = ZipkinServer(
+        ServerConfig(storage_type="tpu"), storage=resumed,
+    )
+
+    async def scenario():
+        metrics = json.loads(
+            (await server.get_metrics(None)).body.decode()
+        )
+        prom = (await server.get_prometheus(None)).text
+        return metrics, prom
+
+    metrics, prom = asyncio.run(scenario())
+    assert metrics["gauge.zipkin_tpu.walReplayBatches"] == len(bs)
+    assert metrics["gauge.zipkin_tpu.walReplayMs"] > 0
+    assert "gauge.zipkin_tpu.restoreMs" in metrics
+    # ingest_counters carries them, so /prometheus exports them as
+    # zipkin_tpu_* lines without per-gauge wiring
+    assert "zipkin_tpu_wal_replay_batches 3" in prom
+    assert "zipkin_tpu_restore_ms" in prom
+    resumed.close()

@@ -1,0 +1,166 @@
+"""maybe_restore rejection branches + snapshot commit protocol (ISSUE 3).
+
+Every rejection branch must (a) refuse the restore, (b) log a warning
+that names the cause, and (c) leave the store fully usable — a refused
+restore is a cold boot, not a crash. The commit-protocol tests pin the
+generation-named state files that make a snapshot crash-consistent
+(meta.json is the single atomic commit point; see tpu/snapshot.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from tests.fixtures import lots_of_spans
+from zipkin_tpu.parallel.mesh import make_mesh
+from zipkin_tpu.tpu import snapshot
+from zipkin_tpu.tpu.state import AggConfig
+from zipkin_tpu.tpu.store import TpuStorage
+
+CFG = AggConfig(
+    max_services=16, max_keys=64, hll_precision=6, digest_centroids=8,
+    digest_buffer=512, ring_capacity=512, link_buckets=2,
+    bucket_minutes=60, hist_slices=2,
+)
+
+
+def _store(n_devices=1):
+    return TpuStorage(config=CFG, mesh=make_mesh(n_devices), pad_to_multiple=64)
+
+
+def _saved(tmp_path):
+    store = _store()
+    store.accept(lots_of_spans(120, seed=7, services=4, span_names=6)).execute()
+    d = str(tmp_path / "snap")
+    snapshot.save(store, d)
+    return store, d
+
+
+def _meta(d):
+    return json.load(open(os.path.join(d, snapshot.META_FILE)))
+
+
+def _write_meta(d, meta):
+    json.dump(meta, open(os.path.join(d, snapshot.META_FILE), "w"))
+
+
+def _assert_usable(store):
+    store.accept(lots_of_spans(60, seed=9, services=4, span_names=6)).execute()
+    assert store.agg.host_counters["spans"] > 0
+    assert store.trace_cardinalities()  # a read round-trips
+
+
+def _refused(store, d, caplog, needle):
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        assert not snapshot.maybe_restore(store, d)
+    assert needle in caplog.text, caplog.text
+    _assert_usable(store)
+
+
+def test_version_mismatch_refused_with_cause(tmp_path, caplog):
+    store, d = _saved(tmp_path)
+    meta = _meta(d)
+    meta["version"] = snapshot.SNAPSHOT_VERSION - 1
+    _write_meta(d, meta)
+    _refused(store, d, caplog, "format version")
+
+
+def test_config_mismatch_refused_with_cause(tmp_path, caplog):
+    store, d = _saved(tmp_path)
+    meta = _meta(d)
+    meta["config"] = dict(meta["config"], max_keys=9999)
+    _write_meta(d, meta)
+    _refused(store, d, caplog, "config changed")
+
+
+def test_shard_count_mismatch_refused_with_cause(tmp_path, caplog):
+    _, d = _saved(tmp_path)  # snapshot taken on a 1-shard mesh
+    two = _store(n_devices=2)
+    _refused(two, d, caplog, "shards")
+
+
+def test_leaf_count_mismatch_refused_with_cause(tmp_path, caplog):
+    store, d = _saved(tmp_path)
+    state_path = os.path.join(d, _meta(d)["state_file"])
+    loaded = np.load(state_path)
+    arrays = {f"f{i}": loaded[f"f{i}"] for i in range(len(loaded.files) - 1)}
+    with open(state_path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    _refused(store, d, caplog, "leaf count")
+
+
+def test_leaf_shape_mismatch_refused_with_cause(tmp_path, caplog):
+    store, d = _saved(tmp_path)
+    state_path = os.path.join(d, _meta(d)["state_file"])
+    loaded = np.load(state_path)
+    arrays = {f"f{i}": loaded[f"f{i}"] for i in range(len(loaded.files))}
+    # same version + config + leaf count, but one leaf's sizing drifted
+    f0 = arrays["f0"]
+    arrays["f0"] = np.zeros(tuple(s + 1 for s in f0.shape), f0.dtype)
+    with open(state_path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    _refused(store, d, caplog, "layout drift")
+    # the warning names the drifted leaf, not just "a leaf"
+    fields = getattr(type(store.agg.state), "_fields", None)
+    assert (fields[0] if fields else "f0") in caplog.text
+
+
+def test_missing_state_file_refused_with_cause(tmp_path, caplog):
+    store, d = _saved(tmp_path)
+    os.unlink(os.path.join(d, _meta(d)["state_file"]))
+    _refused(store, d, caplog, "missing state file")
+
+
+def test_intact_snapshot_restores(tmp_path):
+    store, d = _saved(tmp_path)
+    fresh = _store()
+    assert snapshot.maybe_restore(fresh, d)
+    assert fresh.agg.host_counters == store.agg.host_counters
+    assert fresh.vocab.services._names == store.vocab.services._names
+
+
+# -- commit protocol -----------------------------------------------------
+
+
+def test_generations_pruned_and_meta_references_state(tmp_path):
+    store, d = _saved(tmp_path)
+    snapshot.save(store, d)
+    snapshot.save(store, d)
+    gens = [n for n in os.listdir(d) if n.startswith("sketch_state-")]
+    assert len(gens) == 1, gens  # superseded generations pruned
+    assert _meta(d)["state_file"] == gens[0]
+    assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+
+
+def test_legacy_snapshot_layout_still_restores(tmp_path):
+    """Snapshots written before the commit protocol have a fixed-name
+    state file and no state_file key in meta; they must keep restoring."""
+    store, d = _saved(tmp_path)
+    meta = _meta(d)
+    os.replace(
+        os.path.join(d, meta.pop("state_file")),
+        os.path.join(d, snapshot.STATE_FILE),
+    )
+    _write_meta(d, meta)
+    fresh = _store()
+    assert snapshot.maybe_restore(fresh, d)
+    assert fresh.agg.host_counters == store.agg.host_counters
+    # and the next save retires the legacy file for the new protocol
+    snapshot.save(fresh, d)
+    assert not os.path.exists(os.path.join(d, snapshot.STATE_FILE))
+    assert "state_file" in _meta(d)
+
+
+def test_save_rejects_unknown_future_fields_roundtrip(tmp_path):
+    """Config identity is exact: a snapshot taken under the same config
+    round-trips dataclasses.asdict comparison through JSON."""
+    store, d = _saved(tmp_path)
+    want = json.loads(json.dumps(dataclasses.asdict(store.config)))
+    assert _meta(d)["config"] == want

@@ -1,0 +1,148 @@
+"""Resume supervisor (ISSUE 3): degraded-window detection, deadline
+trips, and the snapshot→exit→boot→resume round trip with zero
+acked-span loss."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tests.test_wal import assert_query_parity, batches, make
+from zipkin_tpu.runtime.supervisor import EX_RESTART, ResumeSupervisor
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive(sup, clock, rate, seconds, spans_start=0):
+    """Advance 1 second per observe at the given spans/s; returns the
+    (last reason, final span count)."""
+    spans = spans_start
+    reason = None
+    for _ in range(seconds):
+        clock.t += 1.0
+        spans += rate
+        reason = sup.observe(spans)
+        if reason:
+            break
+    return reason, spans
+
+
+def test_degraded_windows_trip_against_rolling_baseline():
+    clock = FakeClock()
+    sup = ResumeSupervisor(
+        None, window_s=1.0, warmup_windows=3, degraded_fraction=0.5,
+        degraded_windows=3, clock=clock,
+    )
+    sup.observe(0)  # establishes t0
+    reason, spans = _drive(sup, clock, rate=1000, seconds=6)
+    assert reason is None
+    assert sup.baseline_rate() == pytest.approx(1000.0)
+
+    # one bad window then recovery: no trip, the run counter resets
+    reason, spans = _drive(sup, clock, 100, 1, spans)
+    assert reason is None
+    reason, spans = _drive(sup, clock, 1000, 3, spans)
+    assert reason is None
+
+    # a sustained collapse trips after exactly degraded_windows windows
+    reason, spans = _drive(sup, clock, 100, 2, spans)
+    assert reason is None
+    reason, spans = _drive(sup, clock, 100, 1, spans)
+    assert reason == "degraded"
+    assert sup.tripped == "degraded"
+    # degraded windows never fed the baseline
+    assert sup.baseline_rate() == pytest.approx(1000.0)
+    # sticky: later observations keep reporting the trip
+    assert sup.observe(spans + 1000) == "degraded"
+    stats = sup.stats()
+    assert stats["supervisorTripped"] == "degraded"
+    assert stats["supervisorBaselineRate"] == pytest.approx(1000.0)
+
+
+def test_deadline_trips_regardless_of_rate():
+    clock = FakeClock()
+    sup = ResumeSupervisor(
+        None, window_s=1.0, deadline_s=5.0, clock=clock,
+    )
+    sup.observe(0)
+    reason, _ = _drive(sup, clock, rate=10_000, seconds=4)
+    assert reason is None
+    reason, _ = _drive(sup, clock, rate=10_000, seconds=1, spans_start=40_000)
+    assert reason == "deadline"
+    assert EX_RESTART == 75
+
+
+def test_threaded_driver_invokes_on_trip():
+    class StubStore:
+        def __init__(self):
+            self.spans = 0
+
+        def ingest_counters(self):
+            return {"spans": self.spans}
+
+    store = StubStore()
+    sup = ResumeSupervisor(store, window_s=0.02, deadline_s=0.05)
+    tripped = threading.Event()
+    reasons = []
+    sup.start(lambda r: (reasons.append(r), tripped.set()))
+    assert tripped.wait(5.0)
+    sup.stop()
+    assert reasons == ["deadline"]
+
+
+def test_round_trip_snapshot_exit_boot_resume_zero_acked_loss(tmp_path):
+    """The acceptance-criteria round trip: a supervised run trips, the
+    supervisor drains + snapshots, the process 'exits' (store
+    abandoned), a relaunch boots from the same dirs, and the resumed
+    run finishes with bit-identical parity vs an uninterrupted oracle —
+    zero acked-span loss across the window boundary."""
+    bs = batches(6)
+    clock = FakeClock()
+
+    # window 1: supervised ingest trips on its deadline mid-run
+    victim = make(tmp_path)
+    sup = ResumeSupervisor(
+        victim, window_s=1.0, deadline_s=3.5, clock=clock,
+    )
+    sent = 0
+    tripped_at = None
+    for i, spans in enumerate(bs):
+        victim.accept(spans).execute()
+        sent = victim.agg.host_counters["spans"]
+        clock.t += 1.0
+        if sup.observe(sent):
+            tripped_at = i
+            break
+    assert tripped_at is not None and tripped_at < len(bs) - 1
+    assert sup.finalize() is not None  # drain + exit snapshot taken
+    acked = victim.agg.host_counters["spans"]
+    del victim  # exit restartable (EX_RESTART): HBM state gone
+
+    # window 2: relaunch restores flagship state and continues
+    resumed = make(tmp_path)
+    assert resumed.agg.host_counters["spans"] == acked  # zero acked loss
+    assert resumed.resume_offset == acked  # transport offset resume point
+    # the exit snapshot covered the WAL, so boot replayed (almost)
+    # nothing — restore came from the snapshot itself
+    assert resumed.restore_stats["walReplayBatches"] == 0
+    for spans in bs[tripped_at + 1:]:
+        resumed.accept(spans).execute()
+
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for spans in bs:
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, resumed)
+
+
+def test_finalize_without_snapshot_dir_is_safe(tmp_path):
+    store = make(tmp_path, checkpoint=False)
+    sup = ResumeSupervisor(store, deadline_s=0.001)
+    assert sup.finalize() is None
+    store.close()

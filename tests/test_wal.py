@@ -290,6 +290,88 @@ def test_snapshot_races_concurrent_ingest(tmp_path):
     assert_query_parity(oracle, revived)
 
 
+def test_truncate_after_reopen_keeps_seq_watermark(tmp_path):
+    """ISSUE 3 satellite: truncating a reopened-but-not-yet-written WAL
+    must keep the newest segment — it is the only carrier of the seq
+    high-water mark. The old guard only protected a segment while a
+    writer held it open, so this truncation deleted everything and the
+    NEXT boot restarted numbering at 1 ≤ snapshot wal_seq, making
+    replay silently skip post-truncate appends."""
+    from zipkin_tpu.tpu.wal import WriteAheadLog
+
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    for _ in range(3):
+        wal.append(np.zeros((1, 2, 4), np.uint32), {"n_spans": 1})
+    wal.close()
+
+    wal2 = WriteAheadLog(str(tmp_path / "wal"))  # reopened, no writes
+    assert wal2._seq == 3
+    wal2.truncate_covered(3)  # a snapshot covers everything
+    wal2.close()
+    assert glob.glob(str(tmp_path / "wal" / "wal-*.log")), (
+        "truncate-after-reopen deleted the newest segment"
+    )
+    wal3 = WriteAheadLog(str(tmp_path / "wal"))
+    seq = wal3.append(np.zeros((1, 2, 4), np.uint32), {"n_spans": 1})
+    assert seq == 4  # numbering continues past the covered records
+    wal3.close()
+
+
+def test_truncate_after_reboot_does_not_lose_later_batches(tmp_path):
+    """Storage-level regression for the same hole: snapshot on a
+    maintenance reboot (restore, snapshot, exit — no new traffic), then
+    normal traffic, then crash. The post-truncate batches must replay."""
+    bs = batches(5)
+    victim = make(tmp_path)
+    for spans in bs[:3]:
+        victim.accept(spans).execute()
+    victim.snapshot()
+    del victim
+    maint = make(tmp_path)  # maintenance reboot: snapshot, no ingest
+    maint.snapshot()
+    del maint
+    survivor = make(tmp_path)
+    for spans in bs[3:]:
+        survivor.accept(spans).execute()
+    del survivor  # crash
+    revived = make(tmp_path)
+    oracle = make(tmp_path / "oracle", wal=False, checkpoint=False)
+    for spans in bs:
+        oracle.accept(spans).execute()
+    assert_query_parity(oracle, revived)
+
+
+def test_records_seeks_past_covered_payloads(tmp_path):
+    """ISSUE 3 satellite: records(from_seq) must seek past covered
+    record bodies instead of reading + CRC-checking them. Observable
+    behavior: corrupting a COVERED payload no longer stops the segment
+    when resuming past it (while a full scan still stops there)."""
+    import struct as _struct
+
+    from zipkin_tpu.tpu import wal as wal_mod
+
+    wal = wal_mod.WriteAheadLog(str(tmp_path / "wal"))
+    for i in range(3):
+        wal.append(np.full((1, 2, 4), i, np.uint32), {"n_spans": 1})
+    wal.close()
+
+    seg = sorted(glob.glob(str(tmp_path / "wal" / "wal-*.log")))[0]
+    data = bytearray(open(seg, "rb").read())
+    hdr = wal_mod._HEADER
+    _, seq, meta_len, _, _ = hdr.unpack(data[: hdr.size])
+    assert seq == 1
+    off = hdr.size + meta_len  # first payload byte of record 1
+    data[off] ^= 0xFF
+    open(seg, "wb").write(bytes(data))
+
+    reader = wal_mod.WriteAheadLog(str(tmp_path / "wal"))
+    # full scan: the corrupt record is seq 1 -> crc fails, segment stops
+    assert [s for s, _, _ in reader.records(0)] == []
+    # resume past it: the body is skipped unverified, later records flow
+    assert [s for s, _, _ in reader.records(1)] == [2, 3]
+    reader.close()
+
+
 def test_append_after_close_raises(tmp_path):
     """A hook captured by a racing thread before close() detached it must
     FAIL on append, not silently reopen the segment and log a batch past

@@ -1,0 +1,124 @@
+"""Crashpoint fault injection for the durability plane (ISSUE 3).
+
+The durability claims in ARCHITECTURE.md ("every 202-acked batch
+replays after kill -9") are only as good as the crash *timing* they
+were tested under. This registry names the exact instants inside the
+write path where a crash is most likely to tear on-disk state, so the
+chaos driver (tests/test_chaos_recovery.py, benchmarks/chaos_soak.py)
+can kill the process AT each of them instead of at whatever instant a
+timer happens to land on:
+
+- ``wal.append.mid``       header+meta of a WAL record written, payload not
+- ``wal.append.pre_fsync`` record fully written+flushed, fsync still pending
+- ``snapshot.post_state``  state ``.npz`` renamed in, meta.json not yet
+- ``snapshot.post_meta``   meta.json renamed in, covered WAL not yet truncated
+- ``archive.mid_segment``  archive frame header+index written, payload not
+
+Arming is either programmatic (``arm(site, nth=..., action=...)`` from
+an in-process test) or via the environment for subprocess drivers:
+``ZT_CRASHPOINT=<site>[:nth]`` fires on the nth pass through the site
+(default 1st); ``ZT_CRASHPOINT_ACTION`` picks ``kill`` (SIGKILL —
+maximum realism, buffered bytes are lost), ``exit`` (``os._exit`` —
+kills the process but buffered C-level file writes already made are
+kept), or ``raise`` (``CrashpointTriggered`` — in-process simulation;
+the caller must abandon the store object, exactly like the existing
+``del victim`` crash idiom in tests/test_wal.py).
+
+The disarmed fast path is two comparisons, so production code keeps
+the hooks compiled in; a site is one-shot — it disarms itself as it
+fires so crash *handling* code can re-enter the same path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+# the site catalog is static so drivers can randomize over it
+SITES = (
+    "wal.append.mid",
+    "wal.append.pre_fsync",
+    "snapshot.post_state",
+    "snapshot.post_meta",
+    "archive.mid_segment",
+)
+
+ENV_VAR = "ZT_CRASHPOINT"
+ENV_ACTION = "ZT_CRASHPOINT_ACTION"
+EXIT_CODE = 137  # what a SIGKILL'd child reports; `exit` mimics it
+
+_ACTIONS = ("kill", "exit", "raise")
+
+
+class CrashpointTriggered(RuntimeError):
+    """Raised by a crashpoint armed with action="raise". The process is
+    notionally dead at this instant: the owning store/WAL/archive object
+    must be abandoned, not used further."""
+
+
+_site: Optional[str] = None
+_nth = 0
+_action = "kill"
+
+
+def arm(site: str, nth: int = 1, action: str = "kill") -> None:
+    """Arm one site to fire on its ``nth`` traversal."""
+    if site not in SITES:
+        raise ValueError(f"unknown crashpoint site {site!r} (see faults.SITES)")
+    if action not in _ACTIONS:
+        raise ValueError(f"unknown crashpoint action {action!r}")
+    global _site, _nth, _action
+    _site, _nth, _action = site, max(1, int(nth)), action
+
+
+def disarm() -> None:
+    global _site, _nth
+    _site, _nth = None, 0
+
+
+def armed_site() -> Optional[str]:
+    return _site
+
+
+def is_armed(site: str) -> bool:
+    return _site == site
+
+
+def crashpoint(site: str) -> None:
+    """Hot-path hook. No-op (two comparisons) unless ``site`` is armed."""
+    global _site, _nth
+    if _site is None or site != _site:
+        return
+    _nth -= 1
+    if _nth > 0:
+        return
+    _site = None  # one-shot: recovery code may re-enter this same path
+    logger.warning("crashpoint %s firing (action=%s)", site, _action)
+    if _action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if _action == "exit":
+        os._exit(EXIT_CODE)
+    raise CrashpointTriggered(site)
+
+
+def _arm_from_env() -> None:
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return
+    site, _, nth = raw.partition(":")
+    try:
+        arm(
+            site.strip(),
+            int(nth) if nth.strip() else 1,
+            os.environ.get(ENV_ACTION, "kill").strip() or "kill",
+        )
+    except ValueError as e:
+        # a typo'd env var must not brick a production boot
+        logger.warning("ignoring %s=%r: %s", ENV_VAR, raw, e)
+
+
+_arm_from_env()

@@ -1,0 +1,1 @@
+"""Host-side runtime supervision for long ingest runs (ISSUE 3)."""

@@ -19,12 +19,20 @@ if __name__ == "__main__":
         "--storage", default=None,
         help="storage backend: tpu|mem (default: $STORAGE_TYPE)",
     )
+    parser.add_argument(
+        "--resume-dir", default=None,
+        help="durable state root: boot restores <dir>/snap, replays "
+        "<dir>/wal, resumes transport offsets; new batches persist "
+        "back under it (default: $TPU_RESUME_DIR)",
+    )
     args = parser.parse_args()
     # env must be set before the app module builds its config
     if args.port is not None:
         os.environ["QUERY_PORT"] = str(args.port)
     if args.storage is not None:
         os.environ["STORAGE_TYPE"] = args.storage
+    if args.resume_dir is not None:
+        os.environ["TPU_RESUME_DIR"] = args.resume_dir
 
     from zipkin_tpu.server.app import run_server
 

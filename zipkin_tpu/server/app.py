@@ -618,6 +618,11 @@ class ZipkinServer:
         for key, value in self.metrics.snapshot().items():
             transport, _, name = key.partition(".")
             out[f"counter.zipkin_collector.{name}.{transport}"] = value
+        # boot-time restore gauges (ISSUE 3): cost of the last recovery
+        restore = getattr(self.storage, "restore_stats", None)
+        if restore:
+            for name, value in restore.items():
+                out[f"gauge.zipkin_tpu.{name}"] = value
         return web.json_response(out)
 
     async def get_prometheus(self, request: web.Request) -> web.Response:

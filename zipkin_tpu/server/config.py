@@ -67,6 +67,13 @@ class ServerConfig:
     tpu_fast_ingest: bool = False  # line-rate JSON->device path
     tpu_fast_archive_sample: int = 64  # 1/N traces archived in fast mode
     tpu_mp_workers: int = 0  # >0: multi-process parse tier (mp_ingest)
+    # one-knob durable boot (ISSUE 3): TPU_RESUME_DIR=<dir> defaults
+    # checkpoint/WAL/archive under <dir>/{snap,wal,archive} so boot runs
+    # the full restore sequence — snapshot restore, WAL-tail replay,
+    # transport offset resume — without wiring three dirs by hand. The
+    # individual TPU_CHECKPOINT_DIR / TPU_WAL_DIR / TPU_ARCHIVE_DIR
+    # knobs still override their piece when both are set.
+    tpu_resume_dir: Optional[str] = None
     tpu_checkpoint_dir: Optional[str] = None
     tpu_wal_dir: Optional[str] = None  # append-log of fused batches (tpu/wal.py)
     # disk-backed raw-span archive (tpu/archive.py): every ingested
@@ -98,11 +105,18 @@ class ServerConfig:
         # the bounded RAM store, the reference's mem posture, so they
         # stay disk-free by default.
         fast_ingest = _env_bool("TPU_FAST_INGEST", False)
+        raw_resume = os.environ.get("TPU_RESUME_DIR") or None
+        resume_dir = os.path.abspath(raw_resume) if raw_resume else None
         raw_archive = os.environ.get("TPU_ARCHIVE_DIR")
         if raw_archive and raw_archive.lower() in ("off", "none", "0"):
             archive_dir = None
         elif raw_archive:
             archive_dir = raw_archive
+        elif resume_dir:
+            # the resume dir's contract is "everything durable lives
+            # here": the raw-span archive rides along so a restarted
+            # server still serves complete traces for pre-crash ids
+            archive_dir = os.path.join(resume_dir, "archive")
         elif fast_ingest:
             # absolute, so a restart from a different cwd finds the
             # same archive instead of silently orphaning it; the server
@@ -137,8 +151,11 @@ class ServerConfig:
             tpu_fast_ingest=fast_ingest,
             tpu_fast_archive_sample=_env_int("TPU_FAST_ARCHIVE_SAMPLE", 64),
             tpu_mp_workers=_env_int("TPU_MP_WORKERS", 0),
-            tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR") or None,
-            tpu_wal_dir=os.environ.get("TPU_WAL_DIR") or None,
+            tpu_resume_dir=resume_dir,
+            tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR")
+            or (os.path.join(resume_dir, "snap") if resume_dir else None),
+            tpu_wal_dir=os.environ.get("TPU_WAL_DIR")
+            or (os.path.join(resume_dir, "wal") if resume_dir else None),
             tpu_wal_fsync=_env_bool("TPU_WAL_FSYNC", False),
             tpu_archive_dir=archive_dir,
             tpu_archive_max_bytes=_env_int(

@@ -53,14 +53,20 @@ class TpuStorage(_CoreTpuStorage):
             archive_segment_bytes=archive_segment_bytes,
         )
         import threading
+        import time
 
         self.batch_size = batch_size
         self.checkpoint_dir = checkpoint_dir
         self._snapshot_lock = threading.Lock()
+        restored = False
         if checkpoint_dir:
             from zipkin_tpu.tpu.snapshot import maybe_restore
 
-            maybe_restore(self, checkpoint_dir)
+            t0 = time.perf_counter()
+            restored = maybe_restore(self, checkpoint_dir)
+            self.restore_stats["restoreMs"] = round(
+                (time.perf_counter() - t0) * 1000.0, 3
+            )
         if wal_dir:
             # boot order matters: restore the snapshot first (sets
             # agg.wal_seq to its cutoff), replay the WAL tail the
@@ -74,8 +80,30 @@ class TpuStorage(_CoreTpuStorage):
             # per-append fsync cost — see ARCHITECTURE.md "durability
             # plane" for the boundary statement
             wal = wal_mod.WriteAheadLog(wal_dir, fsync=wal_fsync)
-            wal_mod.replay(self, wal, from_seq=self.agg.wal_seq)
+            t0 = time.perf_counter()
+            applied = wal_mod.replay(self, wal, from_seq=self.agg.wal_seq)
+            self.restore_stats["walReplayBatches"] = applied
+            self.restore_stats["walReplayMs"] = round(
+                (time.perf_counter() - t0) * 1000.0, 3
+            )
             wal_mod.attach(self, wal)
+        if restored or self.restore_stats["walReplayBatches"]:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "boot resume: snapshot %s (%.1f ms), WAL replayed %d "
+                "batches (%.1f ms); durable span count %d (transport "
+                "offset resume point)",
+                "restored" if restored else "absent",
+                self.restore_stats["restoreMs"],
+                self.restore_stats["walReplayBatches"],
+                self.restore_stats["walReplayMs"],
+                self.agg.host_counters.get("spans", 0),
+            )
+        # transports that track offsets (replay files, Kafka) resume
+        # from the durable span count — the last leg of the boot-time
+        # restore sequence (snapshot -> WAL replay -> transport offset)
+        self.resume_offset = int(self.agg.host_counters.get("spans", 0))
         # the transfer ledger measures SERVING traffic (one pull per
         # query is the invariant); boot-time restore/replay pulls are
         # not queries, so the count starts clean here

@@ -50,6 +50,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zipkin_tpu import faults
+
 logger = logging.getLogger(__name__)
 
 _MAGIC = 0x5A415243  # "ZARC"
@@ -357,6 +359,10 @@ class SpanArchive:
             rows[:, 4] += np.uint32(base)
             fh.write(frame)
             fh.write(rows.tobytes())
+            if faults.is_armed("archive.mid_segment"):
+                fh.flush()  # kernel-visible partial frame for the
+                # in-process crash action (matches a post-flush SIGKILL)
+            faults.crashpoint("archive.mid_segment")
             fh.write(payload)
             fh.flush()
             self._live_bytes = base + len(payload)

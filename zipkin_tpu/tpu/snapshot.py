@@ -4,9 +4,27 @@ The reference has no in-process durability — it delegates to storage
 backends and replays from Kafka offsets (SURVEY.md §5 checkpoint row).
 The TPU tier's aggregates live in volatile HBM, so durability is
 explicit here: pull the sharded state to host, write one ``.npz`` plus
-the string vocabularies as JSON, restore on boot. Snapshots are atomic
-(write to temp, rename) and self-describing (config + shard count are
-validated on restore).
+the string vocabularies as JSON, restore on boot.
+
+Crash consistency (ISSUE 3): a snapshot is TWO files, and a crash
+between their renames must never pair a new state with an old meta
+(the old meta's wal_seq would double-replay batches the new state
+already holds). The commit protocol makes ``meta.json`` the single
+atomic commit point:
+
+1. the state is written to a fresh generation-named file
+   (``sketch_state-<gen>.npz``), fsynced, renamed in, dir fsynced —
+   the previous generation is untouched;
+2. ``meta.json`` (which names its state file) is written the same way —
+   ``os.replace`` flips the snapshot from old pair to new pair in one
+   atomic step;
+3. only then are superseded state generations pruned.
+
+A crash at any instant (the ``snapshot.post_state`` / ``post_meta``
+crashpoints in zipkin_tpu.faults pin the two worst ones) leaves
+meta.json referencing one COMPLETE state file. fsync before each
+rename is what makes the rename itself crash-durable: a rename of
+unflushed data can survive a power cut while the bytes do not.
 
 Replay markers: the snapshot records ingest counters; transports that
 support offsets (replay files, Kafka) can resume from
@@ -25,19 +43,43 @@ from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
+from zipkin_tpu import faults
+
 if TYPE_CHECKING:  # pragma: no cover
     from zipkin_tpu.tpu.store import TpuStorage
 
 logger = logging.getLogger(__name__)
 
-STATE_FILE = "sketch_state.npz"
+STATE_FILE = "sketch_state.npz"  # legacy single-generation name (read-only)
 META_FILE = "meta.json"
+_STATE_PREFIX = "sketch_state-"
 
 # Bump whenever the AggState pytree or the config serialization changes
 # shape (ADVICE r2: v1 silently covered two incompatible layouts and
 # restore failures misattributed the cause to operator config changes).
 # v2 = r2 retention layout (hist_t/rollup leaves, retention config keys).
 SNAPSHOT_VERSION = 2
+
+
+def _fsync_dir(directory: str) -> None:
+    dfd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def _state_generations(directory: str):
+    """[(gen, filename)] for every generation-named state file, sorted."""
+    out = []
+    for name in os.listdir(directory):
+        if name.startswith(_STATE_PREFIX) and name.endswith(".npz"):
+            try:
+                out.append((int(name[len(_STATE_PREFIX):-4]), name))
+            except ValueError:
+                continue
+    out.sort()
+    return out
 
 
 def save(store: "TpuStorage", directory: str) -> str:
@@ -52,15 +94,31 @@ def save(store: "TpuStorage", directory: str) -> str:
     clone, wal_seq, counters = store.agg.state_clone()
     arrays = {f"f{i}": np.asarray(leaf) for i, leaf in enumerate(clone)}
 
+    # stray temp files from a crashed earlier save are dead weight
+    for name in os.listdir(directory):
+        if name.endswith(".tmp"):
+            try:
+                os.unlink(os.path.join(directory, name))
+            except OSError:
+                pass
+
+    gens = _state_generations(directory)
+    gen = (gens[-1][0] + 1) if gens else 1
+    state_name = f"{_STATE_PREFIX}{gen:08d}.npz"
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     with os.fdopen(fd, "wb") as f:  # file object: savez won't append ".npz"
         np.savez_compressed(f, **arrays)
-    os.replace(tmp, os.path.join(directory, STATE_FILE))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(directory, state_name))
+    _fsync_dir(directory)
+    faults.crashpoint("snapshot.post_state")
 
     meta = {
         "version": SNAPSHOT_VERSION,
         "saved_at": time.time(),
         "wal_seq": wal_seq,
+        "state_file": state_name,
         "n_shards": store.agg.n_shards,
         "config": dataclasses.asdict(store.config),
         # agg counters from the locked capture; vocab-overflow counters
@@ -74,18 +132,43 @@ def save(store: "TpuStorage", directory: str) -> str:
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
     with os.fdopen(fd, "w") as f:
         json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(directory, META_FILE))
+    _fsync_dir(directory)
+    faults.crashpoint("snapshot.post_meta")
+
+    # the new pair is durable — superseded generations (and the legacy
+    # un-generationed file, if this dir predates the commit protocol)
+    # can go
+    for old_gen, name in gens:
+        if old_gen != gen:
+            try:
+                os.unlink(os.path.join(directory, name))
+            except OSError:
+                pass
+    try:
+        os.unlink(os.path.join(directory, STATE_FILE))
+    except OSError:
+        pass
     return directory
 
 
 def maybe_restore(store: "TpuStorage", directory: str) -> bool:
     """Restore state + vocab if a compatible snapshot exists."""
-    state_path = os.path.join(directory, STATE_FILE)
     meta_path = os.path.join(directory, META_FILE)
-    if not (os.path.exists(state_path) and os.path.exists(meta_path)):
+    if not os.path.exists(meta_path):
         return False
     with open(meta_path) as f:
         meta = json.load(f)
+    # legacy snapshots (pre-commit-protocol) have no state_file key
+    state_path = os.path.join(directory, meta.get("state_file", STATE_FILE))
+    if not os.path.exists(state_path):
+        logger.warning(
+            "snapshot at %s: meta references missing state file %s; "
+            "ignoring", directory, os.path.basename(state_path),
+        )
+        return False
     if meta.get("version") != SNAPSHOT_VERSION:
         logger.warning(
             "snapshot at %s has format version %s (this build writes %s); "
@@ -113,8 +196,27 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
     leaves = [loaded[f"f{i}"] for i in range(len(loaded.files))]
     template = store.agg.state
     if len(leaves) != len(template):
-        logger.warning("snapshot leaf count mismatch; ignoring")
+        logger.warning(
+            "snapshot at %s has %d state leaves but this build expects "
+            "%d (leaf count mismatch); ignoring",
+            directory, len(leaves), len(template),
+        )
         return False
+    # layout drift fails HERE with names, not later as an opaque device
+    # error mid-device_put (same version+config can still disagree when
+    # a leaf's derived sizing rule changed between builds)
+    fields = getattr(type(template), "_fields", None)
+    for i, (leaf, tmpl) in enumerate(zip(leaves, template)):
+        if tuple(leaf.shape) != tuple(tmpl.shape) or leaf.dtype != tmpl.dtype:
+            logger.warning(
+                "snapshot at %s: leaf %s has shape %s dtype %s but the "
+                "live state template expects shape %s dtype %s (state "
+                "layout drift); ignoring",
+                directory, fields[i] if fields else f"f{i}",
+                tuple(leaf.shape), leaf.dtype,
+                tuple(tmpl.shape), tmpl.dtype,
+            )
+            return False
     with store.agg.lock:
         store.agg.state = jax.device_put(
             type(template)(*leaves), store.agg._sharding

@@ -130,6 +130,15 @@ class TpuStorage(
                 f"pad_to_multiple ({pad_to_multiple})"
             )
         self._closed = False
+        # boot-time restore instrumentation (ISSUE 3): zeros on a cold
+        # boot; the resume-capable storage adapter (storage/tpu.py)
+        # overwrites these with measured restore/replay figures, and
+        # they flow to /prometheus + /metrics via ingest_counters()
+        self.restore_stats = {
+            "restoreMs": 0.0,
+            "walReplayBatches": 0,
+            "walReplayMs": 0.0,
+        }
         # disk-backed raw-span archive (VERDICT r3 order 2): when set,
         # EVERY ingested span's raw JSON is retained on disk behind a
         # trace-id index (retention = a disk-byte budget), so fast-mode
@@ -1075,6 +1084,9 @@ class TpuStorage(
             "nativeVocabOverflow": (
                 self._nvocab.overflow if self._nvocab is not None else 0
             ),
+            # boot-time restore gauges (restoreMs / walReplayBatches /
+            # walReplayMs): how much recovery cost the last boot
+            **self.restore_stats,
             **(self._disk.counters() if self._disk is not None else {}),
         }
 

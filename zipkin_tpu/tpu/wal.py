@@ -38,6 +38,8 @@ from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from zipkin_tpu import faults
+
 logger = logging.getLogger(__name__)
 
 _MAGIC = 0x5A57414C  # "ZWAL"
@@ -82,20 +84,27 @@ class WriteAheadLog:
         payload = np.ascontiguousarray(fused, np.uint32).tobytes()
         meta = dict(meta, shape=list(fused.shape))
         meta_b = json.dumps(meta, separators=(",", ":")).encode()
-        rec = (
-            _HEADER.pack(
-                _MAGIC, self._seq, len(meta_b), len(payload),
-                zlib.crc32(payload),
-            )
-            + meta_b
-            + payload
+        head = _HEADER.pack(
+            _MAGIC, self._seq, len(meta_b), len(payload),
+            zlib.crc32(payload),
         )
-        fh = self._file_for(len(rec))
-        fh.write(rec)
+        rec_len = len(head) + len(meta_b) + len(payload)
+        fh = self._file_for(rec_len)
+        # the record is written in two pieces so the mid-append
+        # crashpoint sits at the worst tear: header+meta on disk, payload
+        # missing — replay must detect the torn record and stop at it
+        fh.write(head + meta_b)
+        if faults.is_armed("wal.append.mid"):
+            fh.flush()  # the partial record must be kernel-visible for
+            # the in-process (raise) crash action to leave the same
+            # on-disk state a SIGKILL after a real flush would
+        faults.crashpoint("wal.append.mid")
+        fh.write(payload)
         fh.flush()
+        faults.crashpoint("wal.append.pre_fsync")
         if self.fsync:
             os.fsync(fh.fileno())
-        self._fh_bytes += len(rec)
+        self._fh_bytes += rec_len
         return self._seq
 
     def _file_for(self, rec_len: int):
@@ -157,6 +166,16 @@ class WriteAheadLog:
                             "WAL %s: bad magic; skipping segment tail", path
                         )
                         break
+                    if seq <= from_seq:
+                        # covered by the snapshot: seek past the body
+                        # instead of reading + CRC-checking bytes the
+                        # caller is about to discard — resume from a
+                        # late snapshot used to decode the entire log
+                        # it then skipped. A seek past EOF (covered torn
+                        # tail) is benign: the next header read comes
+                        # back empty and ends the segment.
+                        fh.seek(meta_len + payload_len, os.SEEK_CUR)
+                        continue
                     meta_b = fh.read(meta_len)
                     payload = fh.read(payload_len)
                     if len(meta_b) < meta_len or len(payload) < payload_len:
@@ -169,8 +188,6 @@ class WriteAheadLog:
                             "WAL %s: bad crc; skipping segment tail", path
                         )
                         break
-                    if seq <= from_seq:
-                        continue
                     meta = json.loads(meta_b)
                     fused = np.frombuffer(payload, np.uint32).reshape(
                         meta["shape"]
@@ -182,9 +199,21 @@ class WriteAheadLog:
     def truncate_covered(self, covered_seq: int) -> None:
         """Delete segments whose every record is <= covered_seq (already
         folded into a durable snapshot)."""
-        for idx, path in self._segments():
-            if self._fh is not None and self._fh_bytes and idx == self._seg_idx - 1:
-                continue  # never unlink the live segment
+        segs = self._segments()
+        newest_idx = segs[-1][0] if segs else -1
+        for idx, path in segs:
+            if idx == newest_idx:
+                # Never unlink the newest segment, even when fully
+                # covered. It is the live segment when one is open, and
+                # after a reopen-without-writes it is the only carrier
+                # of the seq high-water mark: deleting it would make the
+                # next boot's records() scan find nothing, restart
+                # numbering at 1, and hand post-truncate appends seqs
+                # <= the snapshot's wal_seq — which replay would then
+                # silently skip (acked-span loss). The old guard
+                # (`self._fh is not None and self._fh_bytes`) only
+                # protected the segment while a writer had it open.
+                continue
             max_seq = 0
             try:
                 with open(path, "rb") as fh:
