@@ -1,0 +1,130 @@
+"""Sampling-tier overhead bench (ISSUE 4): what does a verdict cost?
+
+A/B over the SAME ingest stream:
+
+- ``off``      — sampling disabled (the PR-3 baseline path)
+- ``on``       — tier armed at a ~50% hash-drop rate (verdict in the
+                 device step + host gating of archive/WAL retention)
+
+plus two micro legs isolating the host side:
+
+- ``host_verdict``  — pure numpy reference verdict, spans/sec
+- ``compact_fused`` — WAL lane compaction at the measured drop mix
+
+Prints one JSON line. Run: ``python -m benchmarks.sampling_bench``
+(CPU backend is fine; the numbers are relative).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _payloads(total: int, batch: int, base: int = 1):
+    ts = 1_753_000_000_000_000
+    out = []
+    for lo in range(0, total, batch):
+        parts = []
+        for i in range(lo, min(lo + batch, total)):
+            parts.append(
+                '{"traceId":"%016x","id":"%016x","name":"op-%d",'
+                '"timestamp":%d,"duration":%d,'
+                '"localEndpoint":{"serviceName":"svc-%d"}}'
+                % (i + base, i + base, i % 40, ts + i, 100 + i % 9000, i % 24)
+            )
+        out.append(("[" + ",".join(parts) + "]").encode())
+    return out
+
+
+def _throughput(store, payloads, passes: int) -> float:
+    best = 0.0
+    for _ in range(passes):
+        start = time.perf_counter()
+        total = 0
+        for p in payloads:
+            accepted, _ = store.ingest_json_fast(p)
+            total += accepted
+        store.agg.block_until_ready()
+        best = max(best, total / (time.perf_counter() - start))
+    return best
+
+
+def main() -> None:
+    from zipkin_tpu import native
+    from zipkin_tpu.sampling import RATE_ONE
+    from zipkin_tpu.sampling.reference import HostSampler
+    from zipkin_tpu.tpu.state import AggConfig
+    from zipkin_tpu.tpu.store import TpuStorage
+
+    if not native.available():
+        print(json.dumps({"metric": "sampling_overhead", "skipped": "no native codec"}))
+        return
+
+    total = int(os.environ.get("BENCH_SAMPLING_SPANS", 262_144))
+    batch = int(os.environ.get("BENCH_SAMPLING_BATCH", 16_384))
+    passes = int(os.environ.get("BENCH_SAMPLING_PASSES", 3))
+    payloads = _payloads(total, batch)
+
+    off = TpuStorage(config=AggConfig(), pad_to_multiple=batch)
+    off.warm(payloads[0])
+    rate_off = _throughput(off, payloads, passes)
+    off.close()
+
+    on = TpuStorage(config=AggConfig(sampling=True), pad_to_multiple=batch)
+    on.warm(payloads[0])
+    half = np.full_like(on.sampler.rate, RATE_ONE // 2)
+    sat = np.full_like(on.sampler.link, 1000)
+    on.sampler.set_tables(half, on.sampler.tail, sat)
+    on.install_sampler()
+    c0 = on.ingest_counters()  # warm() ingested kept-all batches; exclude
+    rate_on = _throughput(on, payloads, passes)
+    c = on.ingest_counters()
+    drop_frac = (c["sampledDropped"] - c0["sampledDropped"]) / max(
+        c["spans"] - c0["spans"], 1
+    )
+
+    # host-side micro legs over a routed wire image of one batch
+    from zipkin_tpu.tpu.columnar import route_fused
+
+    work = on._fast_parse(payloads[0])
+    _, _, chunks = work
+    fused = route_fused(chunks[0][1], on.agg.n_shards)
+    sampler = HostSampler(on.config.max_services, on.config.max_keys)
+    sampler.set_tables(half, sampler.tail, sat)
+    n_lanes = int(((fused[:, 10, :] & 1) != 0).sum())
+
+    start = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        keep = sampler.verdict_fused(fused)
+    verdict_rate = reps * n_lanes / (time.perf_counter() - start)
+
+    start = time.perf_counter()
+    for _ in range(reps):
+        sampler.compact_fused(fused, keep)
+    compact_rate = reps * n_lanes / (time.perf_counter() - start)
+    on.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": "sampling_overhead",
+                "unit": "spans/s",
+                "ingest_off": round(rate_off, 1),
+                "ingest_on": round(rate_on, 1),
+                "overhead_frac": round(1.0 - rate_on / rate_off, 4),
+                "drop_frac": round(drop_frac, 4),
+                "host_verdict": round(verdict_rate, 1),
+                "compact_fused": round(compact_rate, 1),
+                "spans": total,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

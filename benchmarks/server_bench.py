@@ -15,6 +15,21 @@ story rests on proto3, so the server-level number must exist for it):
                proto3 parse on the fast path)
 - ``grpc``   — zipkin.proto3.SpanService/Report unary calls
 
+Decomposition mode (SERVER_BENCH_DECOMPOSE=1, ISSUE 4 satellite): runs
+the same stream through three sinks to split the server-side span cost
+into its layers —
+
+- ``null``  — ``ingest_json_fast`` returns immediately: HTTP handling,
+              body read, format sniff, collector dispatch, thread hop
+              (the *boundary*)
+- ``parse`` — native parse + intern + columnar pack, then the chunks
+              are dropped on the floor (*boundary + parse*)
+- ``full``  — the real store: parse + raw-span archive + device feed
+
+and prints per-span µs for boundary / parse / feed as a table plus one
+JSON line. MP workers are forced off here: the decomposition targets
+the in-process path (workers would move parse off the timed core).
+
 Run from the repo root: ``python -m benchmarks.server_bench``
 (SERVER_BENCH_SPANS, SERVER_BENCH_MP_WORKERS, SERVER_BENCH_FORMAT).
 """
@@ -24,51 +39,17 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import sys
 import time
 
 
-async def run() -> dict:
+async def _drive(server, port: int, fmt: str, payloads, batch: int,
+                 total: int) -> float:
+    """Post ``total`` spans (two requests in flight) and return elapsed
+    seconds. Every response must be the enqueue ack (202 / empty)."""
     from aiohttp import ClientSession, TCPConnector
 
-    from tests.fixtures import lots_of_spans
-    from zipkin_tpu.model import json_v2
-    from zipkin_tpu.server.app import ZipkinServer
-    from zipkin_tpu.server.config import ServerConfig
-    from zipkin_tpu.storage.tpu import TpuStorage
-
-    total = int(os.environ.get("SERVER_BENCH_SPANS", 2_000_000))
-    workers = int(os.environ.get("SERVER_BENCH_MP_WORKERS", 0))
-    fmt = os.environ.get("SERVER_BENCH_FORMAT", "json")
-    batch = 65_536
-    port = int(os.environ.get("SERVER_BENCH_PORT", 19419))
-
-    storage = TpuStorage(batch_size=batch, num_devices=1)
-    server = ZipkinServer(
-        ServerConfig(
-            port=port, host="127.0.0.1", storage_type="tpu",
-            tpu_fast_ingest=True, tpu_mp_workers=workers,
-            grpc_collector_enabled=(fmt == "grpc"), grpc_port=0,
-        ),
-        storage=storage,
-    )
-    await server.start()
-
-    spans = lots_of_spans(2 * batch, seed=7, services=40, span_names=120)
-    if fmt == "json":
-        enc = json_v2.encode_span_list
-        content_type = "application/json"
-    else:
-        from zipkin_tpu.model import proto3
-
-        enc = proto3.encode_span_list
-        content_type = "application/x-protobuf"
-    payloads = [
-        enc(spans[i : i + batch]) for i in range(0, len(spans), batch)
-    ]
-    storage.warm(payloads[0])
-    warm = storage.ingest_counters()["spans"]
-
-    sent = warm
+    sent = 0
     t0 = time.perf_counter()
     if fmt == "grpc":
         import grpc.aio
@@ -83,8 +64,8 @@ async def run() -> dict:
             method = ch.unary_unary(METHOD)
             i = 0
             pending = set()
-            while sent < total + warm or pending:
-                while sent < total + warm and len(pending) < 2:
+            while sent < total or pending:
+                while sent < total and len(pending) < 2:
                     pending.add(
                         asyncio.ensure_future(
                             method(payloads[i % len(payloads)])
@@ -98,14 +79,17 @@ async def run() -> dict:
                 for d in done:
                     assert d.result() == b""
     else:
+        content_type = (
+            "application/json" if fmt == "json" else "application/x-protobuf"
+        )
         url = f"http://127.0.0.1:{port}/api/v2/spans"
         async with ClientSession(connector=TCPConnector(limit=4)) as sess:
             i = 0
             # two requests in flight: the server acks 202 on enqueue, so
             # a single serial client would measure its own think time
             pending = set()
-            while sent < total + warm or pending:
-                while sent < total + warm and len(pending) < 2:
+            while sent < total or pending:
+                while sent < total and len(pending) < 2:
                     pending.add(
                         asyncio.create_task(
                             sess.post(
@@ -123,17 +107,131 @@ async def run() -> dict:
                     resp = d.result()
                     assert resp.status == 202, resp.status
                     resp.release()
+    return time.perf_counter() - t0
+
+
+def _storage_for(leg: str, batch: int):
+    from zipkin_tpu.storage.tpu import TpuStorage
+
+    if leg == "null":
+
+        class NullSink(TpuStorage):
+            def ingest_json_fast(self, data, sampler=None):
+                return 0, 0
+
+        cls = NullSink
+    elif leg == "parse":
+
+        class ParseSink(TpuStorage):
+            def ingest_json_fast(self, data, sampler=None):
+                work = self._fast_parse(data, sampler)
+                if work is None:
+                    return None
+                accepted, dropped, _chunks = work  # feed skipped
+                return accepted, dropped
+
+        cls = ParseSink
+    else:
+        cls = TpuStorage
+    return cls(batch_size=batch, num_devices=1)
+
+
+async def _run_leg(leg: str, fmt: str, port: int, workers: int, payloads,
+                   batch: int, total: int) -> dict:
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+
+    storage = _storage_for(leg, batch)
+    server = ZipkinServer(
+        ServerConfig(
+            port=port, host="127.0.0.1", storage_type="tpu",
+            tpu_fast_ingest=True, tpu_mp_workers=workers,
+            grpc_collector_enabled=(fmt == "grpc"), grpc_port=0,
+        ),
+        storage=storage,
+    )
+    await server.start()
+    if leg == "full":
+        storage.warm(payloads[0])  # compile device programs untimed
+    elif leg == "parse":
+        storage._fast_parse(payloads[0])  # init the native vocab untimed
+    warm = storage.ingest_counters()["spans"]
+    elapsed = await _drive(server, port, fmt, payloads, batch, total)
     if server._mp_ingester is not None:
         await asyncio.to_thread(server._mp_ingester.drain)
     storage.agg.block_until_ready()
-    elapsed = time.perf_counter() - t0
     accepted = storage.ingest_counters()["spans"] - warm
     await server.stop()
+    # the null/parse sinks never feed the device, so the span counter
+    # stays flat — rate them on the spans actually posted instead
+    return {
+        "leg": leg,
+        "spans_per_sec": round((accepted or total) / elapsed, 1),
+        "spans": accepted or total,
+    }
+
+
+async def run() -> dict:
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.model import json_v2
+
+    total = int(os.environ.get("SERVER_BENCH_SPANS", 2_000_000))
+    workers = int(os.environ.get("SERVER_BENCH_MP_WORKERS", 0))
+    fmt = os.environ.get("SERVER_BENCH_FORMAT", "json")
+    decompose = os.environ.get("SERVER_BENCH_DECOMPOSE", "") == "1"
+    batch = 65_536
+    port = int(os.environ.get("SERVER_BENCH_PORT", 19419))
+
+    spans = lots_of_spans(2 * batch, seed=7, services=40, span_names=120)
+    if fmt == "json":
+        enc = json_v2.encode_span_list
+    else:
+        from zipkin_tpu.model import proto3
+
+        enc = proto3.encode_span_list
+    payloads = [
+        enc(spans[i : i + batch]) for i in range(0, len(spans), batch)
+    ]
+
+    if decompose:
+        legs = {}
+        for i, leg in enumerate(("null", "parse", "full")):
+            legs[leg] = await _run_leg(
+                leg, fmt, port + i, 0, payloads, batch, total
+            )
+        us = {k: 1e6 / v["spans_per_sec"] for k, v in legs.items()}
+        table = {
+            "boundary_us_per_span": round(us["null"], 3),
+            "parse_us_per_span": round(us["parse"] - us["null"], 3),
+            "feed_us_per_span": round(us["full"] - us["parse"], 3),
+            "total_us_per_span": round(us["full"], 3),
+        }
+        print("layer      us/span   cum spans/s", file=sys.stderr)
+        for name, src in (
+            ("boundary", "null"), ("parse", "parse"), ("feed", "full"),
+        ):
+            print(
+                f"{name:<10} {table[name + '_us_per_span']:>8.3f}"
+                f" {legs[src]['spans_per_sec']:>13,.0f}",
+                file=sys.stderr,
+            )
+        return {
+            "metric": f"server_{fmt}_ingest_decomposition",
+            "unit": "us/span",
+            **table,
+            "legs": {k: v["spans_per_sec"] for k, v in legs.items()},
+            "format": fmt,
+            "spans_per_leg": total,
+        }
+
+    leg = await _run_leg(
+        "full", fmt, port, workers, payloads, batch, total
+    )
     return {
         "metric": f"server_{fmt}_ingest_spans_per_sec",
-        "value": round(accepted / elapsed, 1),
+        "value": leg["spans_per_sec"],
         "unit": "spans/s",
-        "spans": accepted,
+        "spans": leg["spans"],
         "format": fmt,
         "mp_workers": workers,
         "vs_library_path": "see BENCH artifacts (bench.py json mode)",

@@ -497,6 +497,11 @@ def config4() -> bool:
     arc_bytes = int(os.environ.get("EVAL_ARCHIVE_BYTES", 12 << 30))
     arc_kw = dict(
         archive_dir=arc_dir, archive_max_bytes=arc_bytes,
+        # small segments let a smoke run seal enough of them to ARM the
+        # zone-map pruning gate (search_probe_gate below)
+        archive_segment_bytes=int(
+            os.environ.get("EVAL_ARCHIVE_SEGMENT_BYTES", 64 << 20)
+        ),
     ) if arc_dir else {}
     # bound the async dispatch queue: sync every N batches so mid-stream
     # queries never queue behind an unbounded pipeline (r4's 488/500ms
@@ -553,6 +558,19 @@ def config4() -> bool:
     distinct_per_batch = len({s.trace_id for s in template})
     probe_tid_t = template[0].trace_id
     probe_n = sum(1 for x in template if x.trace_id == probe_tid_t)
+    # getTraces search probes (ISSUE 4 satellite): the SELECTIVE query
+    # names an epoch-0 rotated service — once the rotation moves past
+    # epoch 0, segments sealed under later tokens cannot contain that
+    # service id, so the archive's zone-map sidecars must prune them
+    # without touching their pages (gated: archiveSearchSegmentsSkipped
+    # rises). The BROAD query carries no predicates and early-stops on
+    # the newest segments. Both ride the production getTraces path.
+    sel_service = template[0].local_service_name.replace(
+        "roto0000", _tok(0)
+    )
+    search_skipped0 = int(
+        store.ingest_counters().get("archiveSearchSegmentsSkipped", 0)
+    )
 
     rotate_every = max(rotate_every, 1)
 
@@ -580,8 +598,12 @@ def config4() -> bool:
 
     KINDS = (
         "dependencies", "dependencies_fresh", "percentiles", "windowed",
-        "cardinalities",
+        "cardinalities", "search_selective", "search_broad",
     )
+    # host-side scans (from-scratch rebuild + archive searches): reported
+    # with p50/p99 like everything else but excluded from the device-read
+    # latency gates — they decode spans on the host by design
+    HOST_SIDE = ("dependencies_fresh", "search_selective", "search_broad")
     lat: dict = {k: [] for k in KINDS}  # mid-stream (under ingest load)
     quiesced: dict = {k: [] for k in KINDS}
 
@@ -621,6 +643,17 @@ def config4() -> bool:
               lambda: store.latency_quantiles(
                   [0.5, 0.99], end_ts=end_ts, lookback=lookback), into)
         timed("cardinalities", store.trace_cardinalities, into)
+        if arc_dir:
+            from zipkin_tpu.storage.spi import QueryRequest
+
+            timed("search_selective",
+                  lambda: store.get_traces_query(QueryRequest(
+                      end_ts=end_ts, lookback=lookback, limit=5,
+                      service_name=sel_service)).execute(), into)
+            timed("search_broad",
+                  lambda: store.get_traces_query(QueryRequest(
+                      end_ts=end_ts, lookback=lookback, limit=10,
+                  )).execute(), into)
 
     if fast:
         # compile the query programs outside the timed window (first-call
@@ -870,12 +903,12 @@ def config4() -> bool:
         slo_program_ok = all(
             s is None or (s["p50"] - floor_p50) < 50.0
             for k, s in quiesced_stats.items()
-            if k != "dependencies_fresh"
+            if k not in HOST_SIDE
         )
         slo_gate = "wall_minus_floor"
     load_ok = all(
         s is None or s["p50"] < 500.0
-        for k, s in q_stats.items() if k != "dependencies_fresh"
+        for k, s in q_stats.items() if k not in HOST_SIDE
     )
     fresh_ok = (
         q_stats["dependencies_fresh"] is None
@@ -942,8 +975,30 @@ def config4() -> bool:
             "vocab_overflow_updates": overflow_seen,
             "passed": overflow_seen > 0,
         }
+    # (d) selective search pruned by zone maps: once the rotation has
+    #     moved past epoch 0 AND at least one later segment sealed, the
+    #     epoch-0 service query must have skipped segments without
+    #     touching their pages; with nothing to prune yet the gate stays
+    #     disarmed (reported, trivially passing) — same policy as the
+    #     churn gate above
+    search_gate = None
+    if fast and arc_dir and lat["search_selective"]:
+        seg_count = int(counters.get("archiveSegments", 0))
+        skipped = int(
+            counters.get("archiveSearchSegmentsSkipped", 0)
+            - search_skipped0
+        )
+        armed = epochs >= 2 and seg_count >= 2
+        search_gate = {
+            "selective_service": sel_service,
+            "segments": seg_count,
+            "segments_skipped": skipped,
+            "armed": armed,
+            "passed": (skipped > 0) if armed else True,
+        }
     realism_ok = all(
-        g is None or g["passed"] for g in (hll_gate, probe_gate, churn_gate)
+        g is None or g["passed"]
+        for g in (hll_gate, probe_gate, churn_gate, search_gate)
     )
     ok = (
         counters["spans"] == sent
@@ -988,6 +1043,7 @@ def config4() -> bool:
           distinct_identity_gate=hll_gate,
           archive_probe_gate=probe_gate,
           vocab_churn_gate=churn_gate,
+          search_probe_gate=search_gate,
           archive=archive_stats,
           rotate_every_batches=rotate_every,
           sync_every_batches=sync_every,

@@ -25,6 +25,17 @@ def test_shipped_tree_lints_clean():
     )
 
 
+def test_sampling_subsystem_lints_clean():
+    """ISSUE 4: the tail-sampling tier holds the same bar standalone —
+    zero findings and zero pragmas (the verdict/controller/reference
+    split was designed so no module needs a suppression: device code
+    never pulls, host code never touches compiled programs)."""
+    result = run_paths([str(ROOT / "zipkin_tpu" / "sampling")], root=ROOT)
+    assert not result.errors, result.errors
+    assert result.findings == []
+    assert result.suppressed == []
+
+
 def test_lint_package_lints_itself_clean():
     """Meta: the analyzer holds itself to its own bar — zero findings
     AND zero suppressions (the framework never needs a pragma)."""

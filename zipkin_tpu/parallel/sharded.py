@@ -520,6 +520,10 @@ class ShardedAggregator:
             "spansWithDuration": 0,
             "spansWithError": 0,
             "batches": 0,
+            # tail-sampling verdict tallies (exact, host-counted at the
+            # ingest_fused funnel; 0 when the sampling tier is off)
+            "sampledKept": 0,
+            "sampledDropped": 0,
         }
         # Guards every touch of self.state. Ingest DONATES the state
         # buffers, so a reader racing a step would touch deleted arrays
@@ -565,6 +569,13 @@ class ShardedAggregator:
         # the same lock so replay-from-snapshot is exact.
         self.wal_hook: Optional[callable] = None
         self.wal_seq = 0
+        # tail-sampling gate (zipkin_tpu/sampling.HostSampler): when
+        # installed, every batch through ingest_fused is scored with the
+        # bit-exact host reference — observations feed the controller,
+        # and the WAL persists only the KEPT lanes. Installed by the
+        # storage adapter AFTER boot restore/replay (replayed batches are
+        # already compacted and must not be re-observed).
+        self.sampler = None
         # Monotonic counter bumped on EVERY state mutation (step, flush,
         # rollup, restore) — the read-cache invalidation key. Batch count
         # alone is not enough: rollup_now()/flush change query-visible
@@ -648,10 +659,54 @@ class ShardedAggregator:
                 >= self.config.ring_capacity
             ):
                 self._resident.popleft()
-            if self.wal_hook is not None:
+            if self.sampler is not None:
+                # host reference verdicts over the SAME published tables
+                # the device step just read (both under this lock, so a
+                # controller publish can never straddle a batch): exact
+                # tallies for the controller + kept-lane WAL compaction
+                keep2d = self.sampler.verdict_fused(fused)
+                seen_b, kept_b = self.sampler.observe(fused, keep2d)
+                c["sampledKept"] += kept_b
+                c["sampledDropped"] += seen_b - kept_b
+                if self.wal_hook is not None:
+                    compacted = self.sampler.compact_fused(fused, keep2d)
+                    if compacted is not None:
+                        cf, k_spans, k_dur, k_err, k_ts = compacted
+                        self.wal_seq = self.wal_hook(
+                            cf, k_spans, k_dur, k_err, k_ts,
+                            # pre-compaction tallies: replay restores the
+                            # exact host counters from these (the record
+                            # itself only carries the kept lanes)
+                            extra={
+                                "seen": seen_b, "kept": kept_b,
+                                "seen_dur": n_dur, "seen_err": n_err,
+                            },
+                        )
+            elif self.wal_hook is not None:
                 self.wal_seq = self.wal_hook(
                     fused, n_spans, n_dur, n_err, ts_range
                 )
+
+    def set_sampler_tables(
+        self, rate: np.ndarray, tail: np.ndarray, link: np.ndarray
+    ) -> None:
+        """Publish host-computed sampling tables to the device leaves.
+
+        NOT a compiled program: a zero-copy leaf swap (device_put of the
+        replicated tables + ``_replace``) under the state lock, so the
+        next step — and every later one until the next publish — scores
+        against exactly these tables. Publishing changes no query-visible
+        answer (verdicts only gate retention), so write_version stays."""
+        bt = lambda a: jax.device_put(
+            np.ascontiguousarray(
+                np.broadcast_to(a, (self.n_shards,) + a.shape)
+            ),
+            self._sharding,
+        )
+        with self.lock:
+            self.state = self.state._replace(
+                s_rate=bt(rate), s_tail=bt(tail), s_link=bt(link)
+            )
 
     # -- read path (merged across shards over ICI) -----------------------
     #

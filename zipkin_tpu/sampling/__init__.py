@@ -1,0 +1,55 @@
+"""Adaptive tail-sampling tier (ISSUE 4).
+
+The north star needs a principled overload answer: the throttle sheds
+load by REJECTING batches, which loses exactly the error/outlier traces
+an operator wants most. This tier instead samples RETENTION — sketches
+(t-digest, HLL, link matrices) always see 100% of spans, so percentiles
+and cardinality stay unbiased, while WAL / disk-archive / RAM-archive
+persistence only keeps spans a deterministic verdict selects:
+
+- every ERROR span is kept;
+- every TAIL span is kept (duration >= the published per-(service,
+  spanName) threshold, refreshed from the live t-digests);
+- every span on a RARE dependency edge is kept (published (svc, rsvc)
+  link count below ``sample_rare_min``);
+- the rest keep with per-service probability ``rate/65536`` via a
+  trace-affine salted hash — so a sampled trace is kept or dropped as
+  a UNIT, and replays reproduce identical decisions.
+
+Determinism is the design center: verdicts are a pure u32 function of
+(span fields, published tables). The tables are host-authoritative —
+the controller (controller.py) computes them on host and PUBLISHES them
+by swapping the ``s_rate`` / ``s_tail`` / ``s_link`` state leaves under
+the aggregator lock; the device only reads them. The host reference
+sampler (reference.py) evaluates the same function over the same
+published tables with numpy, so device and host verdicts are
+bit-identical (the tier's parity oracle, tests/test_sampling.py), and a
+crash-resume that restores the tables (snapshot + WAL ``sctl`` deltas)
+reproduces byte-identical verdicts (tests/test_sampling_resume.py).
+"""
+
+from __future__ import annotations
+
+# Salt folded into the trace-id hash before the keep threshold compare:
+# decorrelates the sampling hash from the HLL register hash (both start
+# from fmix32(trace_h)) so dropping hash-low traces cannot bias the
+# cardinality sketch's register selection.
+VERDICT_SALT = 0x53414D50  # "SAMP"
+
+# rate fixed-point: keep probability = rate / RATE_ONE; the hash compare
+# uses the TOP 16 bits of the mixed id, so RATE_ONE (> any h16) is
+# keep-everything and 0 keeps only error/tail/rare spans.
+RATE_ONE = 65536
+
+from zipkin_tpu.sampling.controller import RateController  # noqa: E402
+from zipkin_tpu.sampling.device import device_verdict  # noqa: E402
+from zipkin_tpu.sampling.reference import HostSampler, host_verdict  # noqa: E402
+
+__all__ = [
+    "VERDICT_SALT",
+    "RATE_ONE",
+    "device_verdict",
+    "HostSampler",
+    "host_verdict",
+    "RateController",
+]

@@ -1,0 +1,42 @@
+"""Device half of the sampling tier: the jit-safe verdict function.
+
+Called from the ingest step (tpu/ingest.py) when ``config.sampling`` is
+on. MUST stay bit-identical to :func:`zipkin_tpu.sampling.reference.
+host_verdict` — same salt, same mix, same clip semantics, same operand
+dtypes — that parity is the tier's oracle (tests/test_sampling.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from zipkin_tpu.ops import hashing
+from zipkin_tpu.sampling import VERDICT_SALT
+
+
+def device_verdict(
+    trace_h: jnp.ndarray,
+    svc: jnp.ndarray,
+    rsvc: jnp.ndarray,
+    key: jnp.ndarray,
+    dur: jnp.ndarray,
+    has_dur: jnp.ndarray,
+    err: jnp.ndarray,
+    valid: jnp.ndarray,
+    s_rate: jnp.ndarray,
+    s_tail: jnp.ndarray,
+    s_link: jnp.ndarray,
+    rare_min: int,
+) -> jnp.ndarray:
+    """[n] bool keep verdicts — a pure u32 function of the span fields
+    and the PUBLISHED tables, so replay with the same tables reproduces
+    the same bits. The hash term is trace-affine (trace_h only): a
+    rate-sampled trace is kept or dropped as a unit."""
+    u = jnp.uint32
+    h16 = hashing.fmix32(trace_h ^ u(VERDICT_SALT)) >> u(16)
+    svc_c = jnp.clip(svc, 0, s_rate.shape[0] - 1)
+    rsvc_c = jnp.clip(rsvc, 0, s_rate.shape[0] - 1)
+    key_c = jnp.clip(key, 0, s_tail.shape[0] - 1)
+    tail = has_dur & (dur >= s_tail[key_c])
+    rare = (rsvc > 0) & (s_link[svc_c, rsvc_c] < u(rare_min))
+    return valid & (err | tail | rare | (h16 < s_rate[svc_c]))

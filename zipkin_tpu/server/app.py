@@ -65,6 +65,14 @@ def build_storage(config: ServerConfig) -> StorageComponent:
         from zipkin_tpu.storage.tpu import TpuStorage
         from zipkin_tpu.tpu.state import AggConfig
 
+        agg_kwargs = dict(config.tpu_agg)
+        if config.tpu_sampling:
+            # sampling is a STATIC AggConfig field (it changes the
+            # compiled ingest step), so it rides the agg config rather
+            # than a storage kwarg
+            agg_kwargs["sampling"] = True
+            agg_kwargs["sample_rare_min"] = config.tpu_sampling_rare_min
+
         def _make(archive_dir):
             return TpuStorage(
                 max_span_count=config.mem_max_spans,
@@ -76,8 +84,14 @@ def build_storage(config: ServerConfig) -> StorageComponent:
                 archive_dir=archive_dir,
                 archive_max_bytes=config.tpu_archive_max_bytes,
                 archive_segment_bytes=config.tpu_archive_segment_bytes,
-                config=AggConfig(**config.tpu_agg) if config.tpu_agg else None,
+                config=AggConfig(**agg_kwargs) if agg_kwargs else None,
                 fast_archive_sample=config.tpu_fast_archive_sample,
+                sampling_budget=(
+                    config.tpu_sampling_budget if config.tpu_sampling else 0.0
+                ),
+                sampling_interval_s=config.tpu_sampling_interval_s,
+                sampling_min_rate=config.tpu_sampling_min_rate,
+                sampling_tail_quantile=config.tpu_sampling_tail_quantile,
                 **common,
             )
 
@@ -623,6 +637,19 @@ class ZipkinServer:
         if restore:
             for name, value in restore.items():
                 out[f"gauge.zipkin_tpu.{name}"] = value
+        # sampling-tier gauges (ISSUE 4): retention verdict tallies, the
+        # controller's budget posture, and the live per-service keep rate
+        if getattr(self.storage, "sampler", None) is not None:
+            counters = await asyncio.to_thread(self.storage.ingest_counters)
+            for name in (
+                "sampledKept", "sampledDropped", "budgetUtilization",
+                "samplerPublishes", "samplerPressure",
+            ):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
+            rates = await asyncio.to_thread(self.storage.sampler_rates)
+            for svc, rate in sorted(rates.items()):
+                out[f"gauge.zipkin_tpu.samplerRate.{svc}"] = rate
         return web.json_response(out)
 
     async def get_prometheus(self, request: web.Request) -> web.Response:
@@ -633,10 +660,19 @@ class ZipkinServer:
                 f'zipkin_collector_{name}_total{{transport="{transport}"}} {value}'
             )
         if hasattr(self.storage, "ingest_counters"):
-            # device-tier gauges (sketch occupancy / ingest truth counters)
+            # device-tier gauges (sketch occupancy / ingest truth counters;
+            # with the sampling tier armed this includes sampled_kept /
+            # sampled_dropped / budget_utilization)
             counters = await asyncio.to_thread(self.storage.ingest_counters)
             for name, value in sorted(counters.items()):
                 lines.append(f"zipkin_tpu_{_snake(name)} {value}")
+        if getattr(self.storage, "sampler", None) is not None:
+            # live per-service keep probability (1.0 = keep everything)
+            rates = await asyncio.to_thread(self.storage.sampler_rates)
+            for svc, rate in sorted(rates.items()):
+                lines.append(
+                    f'zipkin_tpu_sampler_rate{{service="{svc}"}} {rate}'
+                )
         return web.Response(text="\n".join(lines) + "\n")
 
     async def get_ui_config(self, request: web.Request) -> web.Response:

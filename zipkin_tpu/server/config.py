@@ -89,6 +89,18 @@ class ServerConfig:
     # periodic snapshot cadence (bounds WAL growth + crash-replay
     # window); active only when a checkpoint dir is configured. 0 = off.
     tpu_snapshot_interval_s: float = 300.0
+    # adaptive tail-sampling tier (zipkin_tpu.sampling): device-side
+    # keep/drop verdicts gate WAL/archive/ring retention while sketches
+    # keep seeing 100% of spans. TPU_SAMPLING=true arms the tier;
+    # TPU_SAMPLING_BUDGET (retained spans/sec, 0 = no controller) drives
+    # the per-service adaptive rate controller — under overload it
+    # tightens rates instead of the throttle shedding at the door.
+    tpu_sampling: bool = False
+    tpu_sampling_budget: float = 0.0
+    tpu_sampling_interval_s: float = 5.0
+    tpu_sampling_min_rate: int = 256
+    tpu_sampling_tail_quantile: float = 0.99
+    tpu_sampling_rare_min: int = 4
     # device state shape (see zipkin_tpu.tpu.state.AggConfig); None =
     # AggConfig's default for that field
     tpu_agg: dict = dataclasses.field(default_factory=dict)
@@ -165,6 +177,14 @@ class ServerConfig:
                 "TPU_ARCHIVE_SEGMENT_BYTES", 64 << 20
             ),
             tpu_snapshot_interval_s=_env_float("TPU_SNAPSHOT_INTERVAL_S", 300.0),
+            tpu_sampling=_env_bool("TPU_SAMPLING", False),
+            tpu_sampling_budget=_env_float("TPU_SAMPLING_BUDGET", 0.0),
+            tpu_sampling_interval_s=_env_float("TPU_SAMPLING_INTERVAL_S", 5.0),
+            tpu_sampling_min_rate=_env_int("TPU_SAMPLING_MIN_RATE", 256),
+            tpu_sampling_tail_quantile=_env_float(
+                "TPU_SAMPLING_TAIL_QUANTILE", 0.99
+            ),
+            tpu_sampling_rare_min=_env_int("TPU_SAMPLING_RARE_MIN", 4),
             tpu_agg=_env_agg(),
         )
 

@@ -34,9 +34,20 @@ class _Throttle:
     def __init__(self, max_concurrency: int, max_queue: int) -> None:
         self._semaphore = threading.BoundedSemaphore(max_concurrency)
         self._queue_slots = threading.BoundedSemaphore(max(max_queue, 1))
+        # overload signal for the sampling tier: when armed (see
+        # ThrottledStorage.set_pressure_delegate), every rejection also
+        # tells the rate controller to tighten per-service keep rates —
+        # degradation order is "sample harder" BEFORE "shed at the door"
+        self.on_reject = None
 
     def run(self, fn):
         if not self._queue_slots.acquire(blocking=False):
+            cb = self.on_reject
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:  # a signal, never a second failure
+                    pass
             raise RejectedExecutionError("storage throttle queue is full")
         try:
             with self._semaphore:
@@ -73,6 +84,17 @@ class ThrottledStorage(StorageComponent):
         self.search_enabled = delegate.search_enabled
         self.autocomplete_keys = delegate.autocomplete_keys
         self._throttle = _Throttle(max_concurrency, max_queue)
+        # auto-wire the overload signal when the wrapped storage carries a
+        # rate controller (TPU tier with TPU_SAMPLING_BUDGET set)
+        controller = getattr(delegate, "sampling_controller", None)
+        if controller is not None:
+            self.set_pressure_delegate(controller.note_pressure)
+
+    def set_pressure_delegate(self, callback) -> None:
+        """Arm ``callback`` to fire on every throttle rejection (the
+        sampling tier's RateController.note_pressure). Pass ``None`` to
+        disarm."""
+        self._throttle.on_reject = callback
 
     def _wrap(self, call: Call) -> Call:
         return _ThrottledCall(call, self._throttle)

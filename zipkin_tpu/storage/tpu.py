@@ -33,6 +33,11 @@ class TpuStorage(_CoreTpuStorage):
         archive_dir: Optional[str] = None,
         archive_max_bytes: int = 2 << 30,
         archive_segment_bytes: int = 64 << 20,
+        sampling_budget: float = 0.0,
+        sampling_interval_s: float = 5.0,
+        sampling_min_rate: int = 256,
+        sampling_tail_quantile: float = 0.99,
+        sampling_rare_min: Optional[int] = None,
     ) -> None:
         mesh = None
         if num_devices is not None:
@@ -51,6 +56,11 @@ class TpuStorage(_CoreTpuStorage):
             archive_dir=archive_dir,
             archive_max_bytes=archive_max_bytes,
             archive_segment_bytes=archive_segment_bytes,
+            sampling_budget=sampling_budget,
+            sampling_interval_s=sampling_interval_s,
+            sampling_min_rate=sampling_min_rate,
+            sampling_tail_quantile=sampling_tail_quantile,
+            sampling_rare_min=sampling_rare_min,
         )
         import threading
         import time
@@ -58,6 +68,12 @@ class TpuStorage(_CoreTpuStorage):
         self.batch_size = batch_size
         self.checkpoint_dir = checkpoint_dir
         self._snapshot_lock = threading.Lock()
+        # boot restore/replay must not re-gate: WAL batches were compacted
+        # to kept lanes at log time and replay restores the exact sampler
+        # counters from record meta — a second verdict pass would re-drop
+        # (or double-count) spans. Disarm the device-plane gate for the
+        # whole resume sequence; install_sampler() re-arms it below.
+        self.agg.sampler = None
         restored = False
         if checkpoint_dir:
             from zipkin_tpu.tpu.snapshot import maybe_restore
@@ -100,6 +116,13 @@ class TpuStorage(_CoreTpuStorage):
                 self.restore_stats["walReplayMs"],
                 self.agg.host_counters.get("spans", 0),
             )
+        # resume is complete: re-arm the sampling tier (publishes the
+        # restored host tables to the device leaves, then reinstalls the
+        # ingest-funnel gate) and only now start the rate controller so
+        # its first tick sees post-replay tallies, not a replay burst
+        self.install_sampler()
+        if self.sampling_controller is not None:
+            self.sampling_controller.start()
         # transports that track offsets (replay files, Kafka) resume
         # from the durable span count — the last leg of the boot-time
         # restore sequence (snapshot -> WAL replay -> transport offset)

@@ -22,6 +22,8 @@ from zipkin_tpu.tpu.columnar import SpanColumns
 from zipkin_tpu.tpu.state import (
     CTR_BATCHES,
     CTR_ERRORS,
+    CTR_SAMPLED_DROPPED,
+    CTR_SAMPLED_KEPT,
     CTR_SPANS,
     CTR_WITH_DURATION,
     AggConfig,
@@ -91,6 +93,32 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     def put(col, new):
         return col.at[pos].set(new[order], mode="drop")
 
+    # --- tail-sampling verdicts (static off by default) -----------------
+    # config.sampling is trace-static, so the off path compiles the exact
+    # pre-sampling step: r_keep untouched, counters 5/6 never written.
+    counters = (
+        state.counters.at[CTR_SPANS].add(live.astype(jnp.uint32))
+        .at[CTR_WITH_DURATION].add(jnp.sum(has_dur).astype(jnp.uint32))
+        .at[CTR_ERRORS].add(jnp.sum(valid & batch.err).astype(jnp.uint32))
+        .at[CTR_BATCHES].add(1)
+    )
+    r_keep = state.r_keep
+    if config.sampling:
+        from zipkin_tpu.sampling.device import device_verdict
+
+        keep = device_verdict(
+            batch.trace_h, batch.svc, batch.rsvc, batch.key,
+            batch.dur, batch.has_dur, batch.err, valid,
+            state.s_rate, state.s_tail, state.s_link,
+            config.sample_rare_min,
+        )
+        n_keep = jnp.sum(keep).astype(jnp.uint32)
+        counters = (
+            counters.at[CTR_SAMPLED_KEPT].add(n_keep)
+            .at[CTR_SAMPLED_DROPPED].add(live.astype(jnp.uint32) - n_keep)
+        )
+        r_keep = put(state.r_keep, keep)
+
     new_state = state._replace(
         hll=new_hll,
         hist=new_hist,
@@ -113,12 +141,10 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
         r_err=put(state.r_err, batch.err),
         r_ts_min=put(state.r_ts_min, batch.ts_min),
         r_valid=put(state.r_valid, valid),
+        r_keep=r_keep,
         r_rolled=put(state.r_rolled, jnp.zeros((n,), bool)),
         ring_pos=(state.ring_pos + live) % config.ring_capacity,
-        counters=state.counters.at[CTR_SPANS].add(live.astype(jnp.uint32))
-        .at[CTR_WITH_DURATION].add(jnp.sum(has_dur).astype(jnp.uint32))
-        .at[CTR_ERRORS].add(jnp.sum(valid & batch.err).astype(jnp.uint32))
-        .at[CTR_BATCHES].add(1),
+        counters=counters,
     )
     return new_state
 

@@ -596,7 +596,18 @@ class MultiProcessIngester:
                 rec[8] = m.svc[rec[8]]
                 rec[9] = m.name[rec[9]]
                 rec[10] = m.key[rec[10]]
-                store.disk_append_record(tuple(rec))
+                rec = tuple(rec)
+                # sampling gate: the fused sketch feed below always sees
+                # 100% of spans; only raw-archive retention is gated.
+                # Gating happens AFTER the local->global remap so the
+                # verdict's svc/rsvc indices address the published link
+                # table, and here (not in disk_append_record) so the
+                # sync fast path is not double-gated.
+                sampler = store.agg.sampler
+                if sampler is not None:
+                    rec = sampler.gate_record(rec)
+                if rec is not None:
+                    store.disk_append_record(rec)
             store.agg.ingest_fused(
                 fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
                 ts_range=ts_range,
@@ -639,6 +650,19 @@ class MultiProcessIngester:
                 spans.append(json_v2.decode_one_span(raw))
             except Exception:  # slice the strict codec rejects: skip
                 continue
+        if not spans:
+            return
+        sampler = self.store.agg.sampler
+        if sampler is not None:
+            # the RAM-archive sample is a retention surface like the disk
+            # archive: gate it with the same verdicts (re-packing the few
+            # 1-in-N sampled spans is cheap; interning is idempotent)
+            from zipkin_tpu.tpu.columnar import pack_spans
+
+            with self.store._intern_lock:
+                cols = pack_spans(spans, self.store.vocab, 1)
+            keep = sampler.verdict_cols(cols)[: len(spans)]
+            spans = [s for s, k in zip(spans, keep) if k]
         if spans:
             self.store._archive.accept(spans).execute()
 

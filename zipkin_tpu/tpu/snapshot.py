@@ -58,7 +58,9 @@ _STATE_PREFIX = "sketch_state-"
 # shape (ADVICE r2: v1 silently covered two incompatible layouts and
 # restore failures misattributed the cause to operator config changes).
 # v2 = r2 retention layout (hist_t/rollup leaves, retention config keys).
-SNAPSHOT_VERSION = 2
+# v3 = sampling tier (s_rate/s_tail/s_link tables, r_keep ring column,
+#      sampling/sample_rare_min config keys).
+SNAPSHOT_VERSION = 3
 
 
 def _fsync_dir(directory: str) -> None:
@@ -238,5 +240,10 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
     store.vocab._key_list = [tuple(k) for k in meta["keys"]]
     store.vocab._keys = {tuple(k): i for i, k in enumerate(meta["keys"]) if i}
     store.agg.wal_seq = int(meta.get("wal_seq", 0))
+    # host mirrors that shadow restored leaves (the sampling tier seeds
+    # its published tables from shard 0's copy — leaves are replicated)
+    on_leaves = getattr(store, "on_restored_leaves", None)
+    if on_leaves is not None:
+        on_leaves(dict(zip(fields or (), leaves)))
     logger.info("restored TPU sketch snapshot from %s", directory)
     return True

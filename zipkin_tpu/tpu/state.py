@@ -41,6 +41,10 @@ from zipkin_tpu.ops import histogram
 
 # counter slots (keep CollectorMetrics names in docs/metrics export)
 CTR_SPANS, CTR_SPANS_DROPPED, CTR_WITH_DURATION, CTR_ERRORS, CTR_BATCHES = range(5)
+# tail-sampling verdict tallies (zipkin_tpu/sampling): spans the device
+# sampler kept / dropped for RETENTION — sketches still saw all of them
+CTR_SAMPLED_KEPT = 5
+CTR_SAMPLED_DROPPED = 6
 NUM_COUNTERS = 8
 
 
@@ -69,6 +73,14 @@ class AggConfig:
     bucket_minutes: int = 60
     hist_slices: int = 8
     hist_slice_minutes: int = 60
+    # tail-sampling tier (zipkin_tpu/sampling): when on, the ingest step
+    # scores every span against the published sampler tables (s_rate /
+    # s_tail / s_link leaves) and records the keep verdict in the r_keep
+    # ring column + counter slots 5/6. Static so sampling=False compiles
+    # the exact pre-sampling step. rare_min: a (svc, rsvc) edge whose
+    # published link count is below this is "rare" and always kept.
+    sampling: bool = False
+    sample_rare_min: int = 4
 
     def __post_init__(self) -> None:
         # the packed wire image gives service ids 16 bits and sketch keys
@@ -127,6 +139,11 @@ class AggState(NamedTuple):
     r_err: jnp.ndarray  # bool
     r_ts_min: jnp.ndarray  # u32
     r_valid: jnp.ndarray  # bool
+    # tail-sampling verdict per ring lane (meaningful iff config.sampling;
+    # all-False otherwise). The ring itself retains 100% of spans — link
+    # joins need whole-trace context — r_keep only RECORDS the device
+    # verdict so the parity oracle can read it back.
+    r_keep: jnp.ndarray  # bool
     # rolled lanes already contributed their links to the rollup matrices:
     # they no longer EMIT edges but stay JOIN-VISIBLE (a live child can
     # still resolve a rolled parent until the lane is overwritten)
@@ -136,6 +153,15 @@ class AggState(NamedTuple):
     rollup_calls: jnp.ndarray  # u32 [D, S, S]
     rollup_errs: jnp.ndarray  # u32 [D, S, S]
     rollup_epoch: jnp.ndarray  # i32 [D] — absolute bucket held, -1 empty
+    # published tail-sampling tables (zipkin_tpu/sampling). These are
+    # HOST-AUTHORITATIVE: the controller computes them on host and
+    # publishes by swapping the leaves under the aggregator lock; the
+    # device only READS them, so every shard holds identical content and
+    # verdicts are a pure function of (span, published tables) — the
+    # foundation of host/device verdict parity and crash-resume replay.
+    s_rate: jnp.ndarray  # u32 [S] — per-service keep rate, 65536 = keep all
+    s_tail: jnp.ndarray  # u32 [K] — per-key tail-latency threshold (µs)
+    s_link: jnp.ndarray  # u32 [S, S] — published (svc, rsvc) edge counts
     counters: jnp.ndarray  # u32 [NUM_COUNTERS]
 
 
@@ -162,6 +188,7 @@ def init_state(config: AggConfig) -> AggState:
         r_err=jnp.zeros((r,), bool),
         r_ts_min=z32,
         r_valid=jnp.zeros((r,), bool),
+        r_keep=jnp.zeros((r,), bool),
         r_rolled=jnp.zeros((r,), bool),
         ring_pos=jnp.zeros((), jnp.int32),
         rollup_calls=jnp.zeros(
@@ -173,6 +200,14 @@ def init_state(config: AggConfig) -> AggState:
             jnp.uint32,
         ),
         rollup_epoch=jnp.full((config.link_buckets,), -1, jnp.int32),
+        # sampler tables boot in "keep everything" posture: max rate, an
+        # unreachable tail threshold, and zero published link counts
+        # (every edge rare). The controller publishes real tables later.
+        s_rate=jnp.full((config.max_services,), 65536, jnp.uint32),
+        s_tail=jnp.full((config.max_keys,), 0xFFFFFFFF, jnp.uint32),
+        s_link=jnp.zeros(
+            (config.max_services, config.max_services), jnp.uint32
+        ),
         counters=jnp.zeros((NUM_COUNTERS,), jnp.uint32),
     )
 

@@ -83,6 +83,11 @@ class TpuStorage(
         archive_dir: Optional[str] = None,
         archive_max_bytes: int = 2 << 30,
         archive_segment_bytes: int = 64 << 20,
+        sampling_budget: float = 0.0,
+        sampling_interval_s: float = 5.0,
+        sampling_min_rate: int = 256,
+        sampling_tail_quantile: float = 0.99,
+        sampling_rare_min: Optional[int] = None,
     ) -> None:
         from zipkin_tpu.parallel.sharded import ShardedAggregator
 
@@ -100,6 +105,36 @@ class TpuStorage(
             max_services=self.config.max_services, max_keys=self.config.max_keys
         )
         self.agg = ShardedAggregator(self.config, mesh=mesh)
+        # adaptive tail-sampling tier (zipkin_tpu/sampling): the host
+        # reference sampler gates RETENTION (WAL via ingest_fused, disk
+        # archive, RAM archive sample) while device sketches keep seeing
+        # 100% of spans. Installed on the aggregator immediately for a
+        # cold boot; the resume adapter (storage/tpu.py) detaches it
+        # around restore/replay and re-installs after the final tables
+        # are pushed back to the device.
+        self.sampler = None
+        self.sampling_controller = None
+        if self.config.sampling:
+            from zipkin_tpu.sampling import HostSampler, RateController
+
+            self.sampler = HostSampler(
+                self.config.max_services,
+                self.config.max_keys,
+                rare_min=(
+                    self.config.sample_rare_min
+                    if sampling_rare_min is None
+                    else sampling_rare_min
+                ),
+            )
+            self.agg.sampler = self.sampler
+            if sampling_budget > 0:
+                self.sampling_controller = RateController(
+                    self,
+                    budget_spans_per_sec=sampling_budget,
+                    interval_s=sampling_interval_s,
+                    min_rate=sampling_min_rate,
+                    tail_quantile=sampling_tail_quantile,
+                )
         self._archive = InMemoryStorage(
             max_span_count=archive_max_span_count,
             strict_trace_id=strict_trace_id,
@@ -312,6 +347,39 @@ class TpuStorage(
                 json.dump(meta, f)
             _os.replace(tmp, self._archive_vocab_path)
 
+    # -- sampling tier hooks ---------------------------------------------
+
+    def on_restored_leaves(self, leaves: dict) -> None:
+        """Snapshot-restore callback (tpu/snapshot.maybe_restore): seed
+        the sampling tier's host mirror from the restored device leaves
+        (shard 0's copy — the published tables are replicated across
+        shards by construction)."""
+        if self.sampler is None or "s_rate" not in leaves:
+            return
+        self.sampler.restore_tables(
+            leaves["s_rate"][0], leaves["s_tail"][0], leaves["s_link"][0]
+        )
+
+    def apply_sctl(self, delta: dict) -> None:
+        """WAL-replay callback (tpu/wal.replay): apply one replayed
+        controller publish to the host mirror at its exact point of the
+        batch stream, so later replayed verdicts read the same tables
+        the live run did. The device leaves are pushed to match when the
+        resume adapter re-installs the sampler (storage/tpu.py)."""
+        if self.sampler is not None:
+            self.sampler.apply_sctl(delta)
+
+    def install_sampler(self) -> None:
+        """(Re-)arm the sampling gate after boot restore/replay: push the
+        host mirror's tables to the device leaves and attach the sampler
+        to the ingest funnel. No-op when the tier is off."""
+        if self.sampler is None:
+            return
+        self.agg.set_sampler_tables(
+            self.sampler.rate, self.sampler.tail, self.sampler.link
+        )
+        self.agg.sampler = self.sampler
+
     # -- SPI factories ---------------------------------------------------
 
     def span_consumer(self) -> SpanConsumer:
@@ -332,16 +400,23 @@ class TpuStorage(
         def run() -> None:
             if not spans:
                 return
-            self._archive.accept(spans).execute()
-            if self._disk is not None:
-                self._disk_append_spans(spans)
             # chunk: a giant POST must not exceed the device batch bound
-            # (state transitions serialize on the aggregator's own lock)
+            # (state transitions serialize on the aggregator's own lock).
+            # With the sampling tier on, archive/disk retention keeps
+            # only verdict-kept spans — the device (below) still ingests
+            # the FULL batch so sketches see 100%.
             for lo in range(0, len(spans), self.max_batch):
+                chunk = spans[lo : lo + self.max_batch]
                 with self._intern_lock:
-                    cols = pack_spans(
-                        spans[lo : lo + self.max_batch], self.vocab, self._pad
-                    )
+                    cols = pack_spans(chunk, self.vocab, self._pad)
+                kept = chunk
+                if self.agg.sampler is not None:
+                    keep = self.agg.sampler.verdict_cols(cols)[: len(chunk)]
+                    kept = [s for s, k in zip(chunk, keep) if k]
+                if kept:
+                    self._archive.accept(kept).execute()
+                    if self._disk is not None:
+                        self._disk_append_spans(kept)
                 self.agg.ingest(cols)
 
         return Call.of(run)
@@ -471,18 +546,46 @@ class TpuStorage(
         return n, dropped, chunks
 
     def _fast_dispatch(self, parsed, cols) -> None:
-        """Device half of the fast path: raw-span archive + sharded ingest."""
+        """Device half of the fast path: raw-span archive + sharded ingest.
+
+        With the sampling tier armed, the archive halves see only the
+        verdict-kept spans (the cols lane order matches the parsed lane
+        order, so one verdict pass gates both); ``agg.ingest`` still
+        feeds the FULL batch so the device sketches stay unbiased."""
+        keep = None
+        if self.agg.sampler is not None:
+            keep = self.agg.sampler.verdict_cols(cols)[: parsed.n]
+        retained = self._sampled_parsed(parsed, keep)
         if self._disk is not None:
-            self._disk_append_parsed(parsed)
+            self._disk_append_parsed(retained)
             if self.autocomplete_keys:
                 # autocompleteTags is served from the RAM archive only
                 # (the disk index has no tag lanes): keep the 1-in-N
                 # sample flowing or fast-path traffic would never
                 # surface tag values (ADVICE r4)
-                self._archive_fast_sample(parsed, parsed.n)
+                self._archive_fast_sample(retained, retained.n)
         else:
-            self._archive_fast_sample(parsed, parsed.n)
+            self._archive_fast_sample(retained, retained.n)
         self.agg.ingest(cols)
+
+    def _sampled_parsed(self, parsed, keep):
+        """Filter a ParsedColumns view down to verdict-kept lanes (the
+        same hole-punching shape the boundary sampler uses in
+        :meth:`_fast_parse`; archive.parsed_record compacts the byte
+        holes). ``keep=None`` (sampling off) or all-kept returns the
+        input untouched."""
+        if keep is None or bool(keep.all()):
+            return parsed
+        from zipkin_tpu import native
+
+        idx = np.nonzero(keep)[0]
+        sub = native.ParsedColumns()
+        sub.data = parsed.data
+        for f in _PARSED_FIELDS:
+            col = getattr(parsed, f, None)
+            setattr(sub, f, None if col is None else col[: parsed.n][idx])
+        sub.n = len(idx)
+        return sub
 
     def _disk_append_parsed(self, parsed) -> None:
         """Write one fast-path chunk's raw spans + index columns to the
@@ -1088,7 +1191,31 @@ class TpuStorage(
             # walReplayMs): how much recovery cost the last boot
             **self.restore_stats,
             **(self._disk.counters() if self._disk is not None else {}),
+            # sampling-tier gauges (samplerPublishes / samplerPressure /
+            # budgetUtilization / samplerRate*) — sampledKept/Dropped
+            # come exact from agg.host_counters above
+            **(
+                self.sampling_controller.counters()
+                if self.sampling_controller is not None
+                else {}
+            ),
         }
+
+    def sampler_rates(self) -> dict:
+        """{service: keep fraction} from the published rate table — the
+        perServiceRate gauge surface (labels, so not in the flat
+        ingest_counters dict). Empty when the sampling tier is off."""
+        sampler = self.agg.sampler
+        if sampler is None:
+            return {}
+        from zipkin_tpu.sampling import RATE_ONE
+
+        out = {}
+        for name in self.vocab.services.names:
+            sid = self.vocab.services.get(name)
+            if sid:
+                out[name] = float(sampler.rate[sid]) / RATE_ONE
+        return out
 
     # -- lifecycle -------------------------------------------------------
 
@@ -1103,6 +1230,8 @@ class TpuStorage(
 
     def close(self) -> None:
         self._closed = True
+        if self.sampling_controller is not None:
+            self.sampling_controller.stop()
         if self._disk is not None:
             self._disk.close()
         self._archive.close()

@@ -254,7 +254,7 @@ def attach(store, wal: WriteAheadLog) -> WriteAheadLog:
     sent["name"] = len(vocab.span_names._names)
     sent["pair"] = len(vocab._key_list)
 
-    def hook(fused, n_spans, n_dur, n_err, ts_range) -> int:
+    def hook(fused, n_spans, n_dur, n_err, ts_range, extra=None) -> int:
         with store._intern_lock:
             svc_new = vocab.services._names[sent["svc"]:]
             name_new = vocab.span_names._names[sent["name"]:]
@@ -262,15 +262,19 @@ def attach(store, wal: WriteAheadLog) -> WriteAheadLog:
             sent["svc"] += len(svc_new)
             sent["name"] += len(name_new)
             sent["pair"] += len(pairs_new)
-        return wal.append(
-            fused,
-            dict(
-                n_spans=n_spans, n_dur=n_dur, n_err=n_err,
-                ts_range=list(ts_range) if ts_range else None,
-                svc=svc_new, names=name_new,
-                pairs=[list(p) for p in pairs_new],
-            ),
+        meta = dict(
+            n_spans=n_spans, n_dur=n_dur, n_err=n_err,
+            ts_range=list(ts_range) if ts_range else None,
+            svc=svc_new, names=name_new,
+            pairs=[list(p) for p in pairs_new],
         )
+        if extra:
+            # sampling-tier sidecar meta: per-batch pre-compaction
+            # seen/kept tallies, or a zero-lane "sctl" table-delta record
+            # (controller publish) replay applies at this exact point of
+            # the batch stream
+            meta.update(extra)
+        return wal.append(fused, meta)
 
     store.agg.wal_hook = hook
     store.wal = wal
@@ -300,13 +304,34 @@ def replay(store, wal: WriteAheadLog, from_seq: int = 0) -> int:
                     # via key_id would shift every id when interning
                     # rules differ between builds (r4 review finding)
                     vocab.append_pair(a, b)
-            ts = meta.get("ts_range")
-            agg.ingest_fused(
-                np.array(fused),  # frombuffer view is read-only
-                n_spans=meta["n_spans"], n_dur=meta["n_dur"],
-                n_err=meta["n_err"],
-                ts_range=tuple(ts) if ts else None,
-            )
+            sctl = meta.get("sctl")
+            if sctl and hasattr(store, "apply_sctl"):
+                # sampling-controller publish: apply the sparse table
+                # delta to the host mirror HERE, between the same two
+                # batches the live run published between — later replayed
+                # verdicts must read the post-publish tables
+                store.apply_sctl(sctl)
+            if fused.shape[-1]:
+                agg.ingest_fused(
+                    np.array(fused),  # frombuffer view is read-only
+                    n_spans=meta["n_spans"], n_dur=meta["n_dur"],
+                    n_err=meta["n_err"],
+                    ts_range=tuple(ts) if (ts := meta.get("ts_range")) else None,
+                )
+            if "seen" in meta:
+                # pre-compaction tallies of a sampled batch: the record
+                # holds only kept lanes, so the ingest above under-counted
+                # — restore the exact host counters from the meta
+                hc = agg.host_counters
+                hc["sampledKept"] += meta.get("kept", 0)
+                hc["sampledDropped"] += meta["seen"] - meta.get("kept", 0)
+                hc["spans"] += meta["seen"] - meta["n_spans"]
+                hc["spansWithDuration"] += (
+                    meta.get("seen_dur", meta["n_dur"]) - meta["n_dur"]
+                )
+                hc["spansWithError"] += (
+                    meta.get("seen_err", meta["n_err"]) - meta["n_err"]
+                )
             agg.wal_seq = seq
             applied += 1
     finally:
