@@ -116,6 +116,88 @@ def main() -> None:
         batch * reps / wall
     )
 
+    # -- dispatch decomposition at 1 vs N shards (ISSUE 5 satellite) -----
+    # Per batch: route_fused -> device_put of the [n, 11, per] wire ->
+    # fused-step enqueue. device_put is timed blocked (it IS host work:
+    # the host->device copy); ingest_fused is timed as dispatched in
+    # production (device_put + async step enqueue + host bookkeeping).
+    # host_us_per_span = (route + ingest_fused) / batch: on a real v5e
+    # the device step overlaps the next batch's parse/pack, so these
+    # host stages are what bounds the aggregate feed rate.
+    shard_table = {}
+    for n in sorted({1, n_shards}):
+        agg_n = agg if n == n_shards else ShardedAggregator(cfg, make_mesh(n))
+        agg_n.ingest(cols)  # compile every fused variant this loop hits
+        agg_n.block_until_ready()
+        wire = route_fused(cols, n)
+        counts = dict(
+            n_spans=int(cols.valid.sum()),
+            n_dur=int((cols.valid & cols.has_dur).sum()),
+            n_err=int((cols.valid & cols.err).sum()),
+        )
+        row = {"lanes_per_shard": int(wire.shape[-1])}
+
+        def timed(fn, reps=reps):
+            fn()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn()
+            return round((time.perf_counter() - t0) * 1e3 / reps, 3)
+
+        row["route_ms_per_batch"] = timed(lambda: route_fused(cols, n))
+        row["device_put_ms_per_batch"] = timed(
+            lambda: jax.block_until_ready(
+                jax.device_put(wire, agg_n._sharding)
+            )
+        )
+        row["ingest_fused_ms_per_batch"] = timed(
+            lambda: agg_n.ingest_fused(wire, **counts)
+        )
+        agg_n.block_until_ready()  # drain the queued async steps
+        row["host_us_per_span"] = round(
+            (row["route_ms_per_batch"] + row["ingest_fused_ms_per_batch"])
+            * 1e3 / batch, 3,
+        )
+        shard_table[str(n)] = row
+    out["dispatch_stages_by_shards"] = shard_table
+
+    # -- the multi-process tier at the same mesh -------------------------
+    # Same wire format, parse/pack in spawn workers, one dispatcher
+    # thread feeding ingest_fused. On a multi-core host the parse stage
+    # scales with workers; the dispatcher's remap+dispatch cost is the
+    # serial floor this measures.
+    mp_out = {}
+    try:
+        from zipkin_tpu.storage.tpu import TpuStorage
+        from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
+
+        mp_workers = int(os.environ.get("FEED_MP_WORKERS", "2"))
+        mp_store = TpuStorage(
+            config=cfg, num_devices=n_shards, batch_size=8192
+        )
+        ingester = MultiProcessIngester(mp_store, workers=mp_workers)
+        try:
+            ingester.submit(payloads["json_v2"])  # warm: compile + intern
+            ingester.drain()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                ingester.submit(payloads["json_v2"])
+            ingester.drain()
+            wall = time.perf_counter() - t0
+            mp_out = {
+                "workers": mp_workers,
+                "chunk_spans": 8192,
+                "mp_feed_spans_per_sec_with_cpu_mesh_step": round(
+                    batch * reps / wall
+                ),
+            }
+        finally:
+            ingester.close()
+            mp_store.close()
+    except Exception as e:  # pragma: no cover - native tier optional
+        mp_out = {"error": str(e)}
+    out["mp_tier"] = mp_out
+
     # the host budget that transfers to a REAL v5e-8 (device step
     # overlaps): sum of host stage costs per span
     per_span_us = sum(

@@ -90,6 +90,12 @@ def main() -> None:
         "artifact": "mesh_fresh_read",
         "devices": int(min(8, n_dev)),
         "ring_capacity_per_shard": cfg.ring_capacity,
+        # ISSUE 5: the fresh read's only sort is the since-rollup delta
+        # segment (2 * rollup_segment union lanes), not the 2 * ring
+        # full union — the persistent ctx order is advanced at rollup
+        # cadence, off the query path
+        "delta_sort_lanes": 2 * cfg.rollup_segment,
+        "full_ring_union_lanes": 2 * cfg.ring_capacity,
         "max_services": cfg.max_services,
         "mesh_program": table,
         "single_shard_hlo_lines": hlo1.count("\n"),

@@ -20,6 +20,7 @@ Run from the repo root on the chip: ``python -m benchmarks.profile_link_ctx``.
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import tempfile
 import time
@@ -69,7 +70,7 @@ def main() -> None:
     from zipkin_tpu.ops import linker
     from zipkin_tpu.ops.segments import segment_starts
 
-    r = 1 << 18
+    r = int(os.environ.get("LINK_CTX_RING", 1 << 18))
     cols = synthetic_ring(r)
     x = linker.LinkInput(**{k: jnp.asarray(v) for k, v in cols.items()})
     x = jax.device_put(x)
@@ -180,6 +181,34 @@ def main() -> None:
     timeit("fixed_doubling", jax.jit(fixed_doubling), parent_host, kindv)
     timeit("converged_doubling", jax.jit(converged_doubling), parent_host, kindv)
 
+    # -- incremental-ctx A/B (ISSUE 5): delta-advance vs from-scratch ----
+    # Steady state the host cadence maintains: the persistent ctx was
+    # advanced over both ring halves (rollup cadence), and a fresh read
+    # resolves only the since-rollup delta against it. Timed here with a
+    # FULL outstanding delta (Δ = rollup_segment) — the worst case the
+    # cadence permits, just before the next advance would run.
+    from zipkin_tpu.ops import delta_linker
+
+    seg = r // 2
+    adv = jax.jit(lambda x, cs: delta_linker.advance(x, cs, seg))
+    delta_read = jax.jit(
+        lambda x, cs: delta_linker.delta_link_context(x, cs, seg)
+    )
+    cs = delta_linker.init_ctx(r)
+    cs = adv(x, cs._replace(delta=jnp.int32(seg)))[0]
+    cs = adv(x, cs._replace(delta=jnp.int32(seg)))[0]
+    cs_read = jax.device_put(cs._replace(delta=jnp.int32(seg)))
+    # exactness spot check rides the artifact (the fuzz suite is the
+    # real proof — tests/test_incremental_ctx.py)
+    got = delta_read(x, cs_read)
+    want = full(x)
+    delta_parity = bool(all(
+        np.array_equal(np.asarray(g), np.asarray(w))
+        for g, w in zip(got, want)
+    ))
+    timeit("delta_fresh_read_full_delta", delta_read, x, cs_read)
+    timeit("ctx_advance_rollup_cadence", adv, x, cs_read)
+
     # XPlane capture for device-time attribution of the same calls
     device = {}
     try:
@@ -191,6 +220,8 @@ def main() -> None:
             pieces["lexsort_4key_2R"](x)
             jax.jit(fixed_doubling)(parent_host, kindv)
             jax.jit(converged_doubling)(parent_host, kindv)
+            delta_read(x, cs_read)
+            adv(x, cs_read)
             jax.block_until_ready(x)
         space = latest_xspace(trace_dir)
         for op, (us, cnt) in sorted(
@@ -204,6 +235,12 @@ def main() -> None:
     print(json.dumps({
         "artifact": "profile_link_ctx",
         "ring_capacity": r,
+        "delta": {
+            "rollup_segment": seg,
+            "delta_sort_lanes": 2 * seg,
+            "full_union_lanes": 2 * r,
+            "parity_with_oracle": delta_parity,
+        },
         "wall_ms_p50": results,
         "device_ops_ms": device,
     }), flush=True)

@@ -104,7 +104,7 @@ def main() -> None:
     def deps_ctx_cached():
         agg.dependency_edges(lo_min, hi_min)
 
-    def deps_ctx_rebuild():
+    def deps_ctx_fresh():
         # force the FRESH path: first-query-after-write dispatches the
         # fused spmd_edges_fresh (maintained-order ctx + edges) — the
         # program that now gates the 50 ms SLO with no exclusions
@@ -140,7 +140,7 @@ def main() -> None:
 
     reads = {
         "dependencies_ctx_cached": deps_ctx_cached,
-        "dependencies_ctx_rebuild": deps_ctx_rebuild,
+        "dependencies_ctx_fresh": deps_ctx_fresh,
         "dependencies_rolled_only": deps_rolled_only,
         "percentiles_pend_fold": percentiles_pend_fold,
         "percentiles_digest": percentiles,
@@ -259,7 +259,7 @@ def main() -> None:
     # the r5 pre-packing edge read sat near 19× on the tunneled relay)
     READ_PROGRAM = {
         "dependencies_ctx_cached": "spmd_edges",
-        "dependencies_ctx_rebuild": "spmd_edges_fresh",
+        "dependencies_ctx_fresh": "spmd_edges_fresh",
         "dependencies_rolled_only": "spmd_edges_rolled",
         "percentiles_pend_fold": "spmd_quant_digest",
         "percentiles_digest": "spmd_quant_digest_nopend",
@@ -271,6 +271,27 @@ def main() -> None:
         for name, prog in READ_PROGRAM.items()
         if program_ms.get(prog)
     }
+    # ISSUE 5 gate: the fresh read now computes ctx via the incremental
+    # delta formulation (persistent ctx + since-rollup segment), so it
+    # carries its own tighter target on top of the 50 ms SLO; ctx
+    # maintenance runs fused inside the rollup dispatch and must stay
+    # inside the rollup's 150 ms amortized bound (checked above).
+    fresh_ms = program_ms.get("spmd_edges_fresh")
+    ctx_report = {
+        "fresh_read_target_ms": 35.0,
+        "fresh_read_captured_ms": fresh_ms,
+        "fresh_read_under_target": bool(
+            fresh_ms is not None and fresh_ms < 35.0
+        ),
+        "ctx_advances": agg.ctx_stats["ctx_advances"],
+        "last_advance_host_wall_ms": round(
+            agg.ctx_stats["ctx_maintenance_ms"], 2
+        ),
+        "delta_lanes_outstanding": agg._lanes_since_rollup,
+        "delta_sort_lanes": 2 * config.rollup_segment,
+        "full_ring_union_lanes": 2 * config.ring_capacity,
+    }
+
     out = {
         "artifact": "query_slo",
         "spans": sent,
@@ -286,6 +307,7 @@ def main() -> None:
         "reads_wall_over_device": wall_over_device,
         "dependency_edges_transfer_ab": edges_ab,
         "program_device_ms_per_dispatch": program_ms,
+        "incremental_ctx": ctx_report,
         "slo_50ms_program_time": slo_device,
         "device_ops_ms": device_ms,
     }

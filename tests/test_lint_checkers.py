@@ -306,6 +306,87 @@ def test_zt06_exempts_benchmarks_and_tests(tmp_path):
         assert rules(lint(tmp_path, ZT06_POSITIVE, name=name)) == []
 
 
+# -- ZT07: fresh-read ring sorts ----------------------------------------
+
+
+ZT07_POSITIVE = """
+    import jax
+    import jax.numpy as jnp
+
+    def _resolve(keys):
+        return jax.lax.sort(keys, num_keys=4)
+
+    def spmd_edges_fresh(state, ts_lo, ts_hi):
+        order = _resolve(state.ring_keys)
+        return order
+"""
+
+
+def test_zt07_flags_sort_reachable_from_fresh_entrypoint(tmp_path):
+    assert_rule_owned(tmp_path, ZT07_POSITIVE, "ZT07")
+
+
+def test_zt07_flags_from_scratch_rebuilder_call(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.ops import linker
+
+        def fresh_link_context(config, state):
+            return linker.link_context(state.ring)
+        """,
+    )
+    assert "ZT07" in rules(result)
+
+
+def test_zt07_ignores_sorts_on_the_rollup_path(tmp_path):
+    # the same sort outside the fresh-read surface (rollup cadence /
+    # oracle) is the design, not a violation
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        def advance(state, seg):
+            return jax.lax.sort(state.ring_keys, num_keys=4)
+
+        def rollup_step(config, state):
+            return advance(state, config.rollup_segment)
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt07_ignores_cumsum_on_fresh_path(tmp_path):
+    # prefix sums are the delta formulation's own workhorse: O(n)
+    # vectorized, not the O(n log n) comparison sort the rule fences
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def delta_resolve(x, cs, seg):
+            return jnp.cumsum(cs.run_starts)
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt07_pragma_with_delta_bound_suppresses(tmp_path):
+    result = lint(
+        tmp_path,
+        ZT07_POSITIVE.replace(
+            "return jax.lax.sort(keys, num_keys=4)",
+            "return jax.lax.sort(keys, num_keys=4)"
+            "  # zt-lint: disable=ZT07 — sorts only the 2*seg delta lanes",
+        ),
+    )
+    assert rules(result) == []
+    assert [f.rule for f in result.suppressed] == ["ZT07"]
+
+
 # -- pragmas and ZT00 ----------------------------------------------------
 
 

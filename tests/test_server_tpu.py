@@ -118,6 +118,13 @@ class TestTpuServer:
             assert "zipkin_tpu_host_transfers " in text
             assert "zipkin_tpu_hll_envelope_exceeded 0" in text
             assert "zipkin_tpu_hll_beyond_envelope_rows 0" in text
+            # incremental link-ctx maintenance gauges (ISSUE 5)
+            assert "zipkin_tpu_ctx_delta_lanes " in text
+            assert "zipkin_tpu_ctx_advances " in text
+            assert "zipkin_tpu_ctx_maintenance_ms " in text
+            body = await (await client.get("/metrics")).json()
+            assert "gauge.zipkin_tpu.ctxDeltaLanes" in body
+            assert "gauge.zipkin_tpu.ctxMaintenanceMs" in body
 
         run(scenario)
 

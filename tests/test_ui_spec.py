@@ -1,0 +1,383 @@
+"""Executable spec of the waterfall/dependency-graph invariants in
+``zipkin_tpu/server/static/app.js`` (ISSUE 5 satellite).
+
+There is no JS engine on this box (test_ui_assets.py documents the
+descope), so the UI's two load-bearing algorithms are mirrored here in
+Python and asserted over the same Lens-conformance fixtures the server
+tests use:
+
+- ``treeOrder``: Lens SpanNode-style waterfall DFS — shared SERVER
+  spans nest under their same-id client half, parentId resolution
+  prefers the shared rendition, children sort by timestamp (missing
+  timestamps last), orphans surface as roots, cycles cannot hang it;
+- ``subtreeEnd``: the contiguous depth-run a collapse fold covers;
+- ``depGraph``: volume-ranked top-48 node cut, circle layout radius
+  and angles, log-scaled edge widths, error coloring, and the
+  direction tick sitting at t=0.7 of the quadratic edge curve.
+
+A final test pins the mirrored constants against the shipped app.js
+source text, so editing the JS without updating this spec (or vice
+versa) fails loudly instead of silently diverging.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from tests.fixtures import TRACE
+from zipkin_tpu.model import json_v2
+from zipkin_tpu.server import ui
+
+
+def _approx(x):
+    return pytest.approx(x, rel=1e-12, abs=1e-9)
+
+
+# ---------------------------------------------------------------- mirrors
+# Line-for-line Python renditions of app.js treeOrder/subtreeEnd/depGraph.
+# Spans are the JSON-v2 dicts the UI receives; identity (id()) stands in
+# for JS object identity in the kids map and visited set.
+
+
+def tree_order(spans):
+    by_id = {}
+    for s in spans:
+        by_id.setdefault(s["id"], []).append(s)
+
+    def parent_of(s):
+        if s.get("shared"):  # server half: parent is the client half
+            mates = [
+                m
+                for m in by_id.get(s["id"], ())
+                if m is not s and not m.get("shared")
+            ]
+            if mates:
+                return mates[0]
+        pid = s.get("parentId")
+        if pid and pid in by_id:
+            # prefer the SHARED rendition (SpanNode's index preference)
+            c = by_id[pid]
+            return next((m for m in c if m.get("shared")), c[0])
+        return None
+
+    kids, roots = {}, []
+    for s in spans:
+        p = parent_of(s)
+        if p is not None:
+            kids.setdefault(id(p), []).append(s)
+        else:
+            roots.append(s)
+
+    def ts(s):
+        return s.get("timestamp") or 1e18
+
+    roots.sort(key=ts)
+    out, seen = [], set()
+
+    def walk(s, d):
+        if id(s) in seen:
+            return
+        seen.add(id(s))
+        out.append((s, d))
+        for k in sorted(kids.get(id(s), ()), key=ts):
+            walk(k, d + 1)
+
+    for r in roots:
+        walk(r, 0)
+    for s in spans:  # cycle leftovers
+        if id(s) not in seen:
+            out.append((s, 0))
+    return out
+
+
+def subtree_end(tree, i):
+    d = tree[i][1]
+    j = i + 1
+    while j < len(tree) and tree[j][1] > d:
+        j += 1
+    return j
+
+
+def dep_graph_layout(links):
+    vol = {}
+    for l in links:
+        vol[l["parent"]] = vol.get(l["parent"], 0) + (l.get("callCount") or 0)
+        vol[l["child"]] = vol.get(l["child"], 0) + (l.get("callCount") or 0)
+    all_names = sorted(vol.keys(), key=lambda n: -vol[n])
+    names = all_names[:48]
+    if not names:
+        return {"names": [], "dropped": 0, "radius": 0, "pos": {}, "edges": []}
+    cx, cy = 400, 250
+    radius = min(200, 60 + len(names) * 8)
+    pos = {}
+    for i, n in enumerate(names):
+        a = 2 * math.pi * i / len(names) - math.pi / 2
+        pos[n] = (cx + radius * math.cos(a), cy + radius * math.sin(a))
+    max_c = 1
+    for l in links:
+        max_c = max(max_c, l.get("callCount") or 1)
+    edges = []
+    for l in links:
+        p, c = pos.get(l["parent"]), pos.get(l["child"])
+        if p is None or c is None:
+            continue  # endpoint fell below the volume cut: edge dropped
+        w = 0.8 + 3 * math.log(1 + (l.get("callCount") or 1)) / math.log(
+            1 + max_c
+        )
+        mx = (p[0] + c[0]) / 2 + (cy - (p[1] + c[1]) / 2) * 0.25
+        my = (p[1] + c[1]) / 2 + ((p[0] + c[0]) / 2 - cx) * 0.25
+        edges.append(
+            {
+                "parent": l["parent"],
+                "child": l["child"],
+                "width": w,
+                "stroke": "#b71c1c" if l.get("errorCount") else "#7986cb",
+                "tick_fill": "#b71c1c" if l.get("errorCount") else "#3f51b5",
+                "p": p,
+                "c": c,
+                "ctrl": (mx, my),
+                "tick": (
+                    0.09 * p[0] + 0.42 * mx + 0.49 * c[0],
+                    0.09 * p[1] + 0.42 * my + 0.49 * c[1],
+                ),
+            }
+        )
+    return {
+        "names": names,
+        "dropped": len(all_names) - len(names),
+        "radius": radius,
+        "pos": pos,
+        "edges": edges,
+    }
+
+
+def _trace_dicts():
+    return json.loads(json_v2.encode_span_list(TRACE))
+
+
+def _span(id, parent=None, ts=None, shared=False, name="s"):
+    d = {"traceId": "1" * 16, "id": id, "name": name}
+    if parent is not None:
+        d["parentId"] = parent
+    if ts is not None:
+        d["timestamp"] = ts
+    if shared:
+        d["shared"] = True
+    return d
+
+
+# ----------------------------------------------------------- waterfall DFS
+
+
+class TestTreeOrder:
+    def test_canonical_trace_nests_shared_server_under_client(self):
+        """The fixture TRACE is the exact shape the shared-span rules
+        exist for: root -> client half -> shared server half -> the
+        server's downstream call, one depth step each."""
+        tree = tree_order(_trace_dicts())
+        got = [(s["id"], s.get("shared", False), d) for s, d in tree]
+        assert got == [
+            ("0000000000000001", False, 0),
+            ("0000000000000002", False, 1),  # client half
+            ("0000000000000002", True, 2),  # server half nests under it
+            ("0000000000000003", False, 3),  # prefers the shared rendition
+        ]
+
+    def test_child_prefers_shared_rendition_of_its_parent(self):
+        # client and shared-server renditions of span "b"; child "c"
+        # names b as parent -> must nest under the SERVER half
+        a = _span("a", ts=1)
+        b_client = _span("b", parent="a", ts=2)
+        b_server = _span("b", parent="a", ts=3, shared=True)
+        c = _span("c", parent="b", ts=4)
+        tree = tree_order([c, b_server, a, b_client])  # order-insensitive
+        depth = {id(s): d for s, d in tree}
+        assert depth[id(c)] == depth[id(b_server)] + 1
+        order = [id(s) for s, _ in tree]
+        assert order.index(id(c)) == order.index(id(b_server)) + 1
+
+    def test_orphans_surface_as_roots_sorted_by_timestamp(self):
+        late = _span("x", parent="missing", ts=900)
+        early = _span("y", parent="also-missing", ts=100)
+        untimed = _span("z", parent="gone")  # ts -> 1e18, sorts last
+        tree = tree_order([late, untimed, early])
+        assert [(s["id"], d) for s, d in tree] == [
+            ("y", 0),
+            ("x", 0),
+            ("z", 0),
+        ]
+
+    def test_children_sort_by_timestamp_missing_last(self):
+        root = _span("r", ts=1)
+        kids = [
+            _span("k3", parent="r", ts=30),
+            _span("k_untimed", parent="r"),
+            _span("k1", parent="r", ts=10),
+            _span("k2", parent="r", ts=20),
+        ]
+        tree = tree_order([root] + kids)
+        assert [s["id"] for s, _ in tree] == [
+            "r",
+            "k1",
+            "k2",
+            "k3",
+            "k_untimed",
+        ]
+        assert [d for _, d in tree] == [0, 1, 1, 1, 1]
+
+    def test_parent_cycle_cannot_hang_and_loses_no_span(self):
+        a = _span("a", parent="b", ts=1)
+        b = _span("b", parent="a", ts=2)
+        solo = _span("s", ts=3)
+        tree = tree_order([a, b, solo])
+        assert len(tree) == 3  # every span rendered exactly once
+        assert sorted(s["id"] for s, _ in tree) == ["a", "b", "s"]
+        # the cycle's leftover (whichever member the DFS never reached)
+        # appends at depth 0, after the real roots
+        assert {d for s, d in tree if s["id"] in ("a", "b")} <= {0, 1}
+        assert {d for s, d in tree if s["id"] == "s"} == {0}
+
+    def test_subtree_end_covers_contiguous_deeper_run(self):
+        root = _span("r", ts=1)
+        a = _span("a", parent="r", ts=2)
+        a1 = _span("a1", parent="a", ts=3)
+        a2 = _span("a2", parent="a", ts=4)
+        b = _span("b", parent="r", ts=5)
+        tree = tree_order([root, a, a1, a2, b])
+        assert [s["id"] for s, _ in tree] == ["r", "a", "a1", "a2", "b"]
+        assert subtree_end(tree, 0) == 5  # whole trace
+        assert subtree_end(tree, 1) == 4  # a + its two kids
+        assert subtree_end(tree, 2) == 3  # leaf covers only itself
+        assert subtree_end(tree, 4) == 5
+
+
+# -------------------------------------------------------- dep-graph layout
+
+
+def _links(n_services=4, calls=lambda i: 10 * (i + 1), errors=lambda i: 0):
+    out = []
+    for i in range(n_services - 1):
+        out.append(
+            {
+                "parent": f"svc{i}",
+                "child": f"svc{i + 1}",
+                "callCount": calls(i),
+                "errorCount": errors(i),
+            }
+        )
+    return out
+
+
+class TestDepGraphLayout:
+    def test_volume_ranked_top48_cut_reports_dropped(self):
+        # 60 services in a chain: volume(svc_i) = calls in + calls out
+        links = _links(60, calls=lambda i: 1000 - i)
+        g = dep_graph_layout(links)
+        assert len(g["names"]) == 48
+        assert g["dropped"] == 12
+        vol = {}
+        for l in links:
+            vol[l["parent"]] = vol.get(l["parent"], 0) + l["callCount"]
+            vol[l["child"]] = vol.get(l["child"], 0) + l["callCount"]
+        kept = set(g["names"])
+        assert all(
+            vol[k] >= vol[d] for k in kept for d in set(vol) - kept
+        )
+        # edges touching a dropped endpoint are skipped, not misdrawn
+        assert all(
+            e["parent"] in kept and e["child"] in kept for e in g["edges"]
+        )
+
+    def test_circle_layout_radius_and_angles(self):
+        g = dep_graph_layout(_links(6))
+        n = len(g["names"])
+        assert g["radius"] == min(200, 60 + n * 8)
+        for i, name in enumerate(g["names"]):
+            x, y = g["pos"][name]
+            assert math.hypot(x - 400, y - 250) == _approx(
+                g["radius"]
+            )
+            a = 2 * math.pi * i / n - math.pi / 2
+            assert x == _approx(400 + g["radius"] * math.cos(a))
+            assert y == _approx(250 + g["radius"] * math.sin(a))
+        # node 0 (highest volume) sits at 12 o'clock
+        x0, y0 = g["pos"][g["names"][0]]
+        assert x0 == _approx(400)
+        assert y0 == _approx(250 - g["radius"])
+
+    def test_radius_saturates_at_200(self):
+        assert dep_graph_layout(_links(50))["radius"] == 200
+
+    def test_edge_width_is_log_scaled_and_bounded(self):
+        links = _links(5, calls=lambda i: [1, 10, 100, 1000][i])
+        g = dep_graph_layout(links)
+        widths = {
+            (e["parent"], e["child"]): e["width"] for e in g["edges"]
+        }
+        ordered = [widths[(l["parent"], l["child"])] for l in links]
+        assert ordered == sorted(ordered)  # monotone in callCount
+        assert ordered[-1] == _approx(3.8)  # maxC edge
+        assert all(0.8 < w <= 3.8 + 1e-9 for w in ordered)
+
+    def test_error_edges_paint_red(self):
+        links = _links(3, errors=lambda i: i)  # first clean, second errors
+        g = dep_graph_layout(links)
+        by_pair = {(e["parent"], e["child"]): e for e in g["edges"]}
+        clean = by_pair[("svc0", "svc1")]
+        bad = by_pair[("svc1", "svc2")]
+        assert (clean["stroke"], clean["tick_fill"]) == (
+            "#7986cb",
+            "#3f51b5",
+        )
+        assert (bad["stroke"], bad["tick_fill"]) == ("#b71c1c", "#b71c1c")
+
+    def test_direction_tick_sits_at_t07_of_the_curve(self):
+        g = dep_graph_layout(_links(7))
+        t = 0.7
+        for e in g["edges"]:
+            for axis in (0, 1):
+                bez = (
+                    (1 - t) ** 2 * e["p"][axis]
+                    + 2 * (1 - t) * t * e["ctrl"][axis]
+                    + t * t * e["c"][axis]
+                )
+                assert e["tick"][axis] == _approx(bez)
+
+    def test_empty_links_collapse_to_nothing(self):
+        g = dep_graph_layout([])
+        assert g["names"] == [] and g["edges"] == []
+
+
+# ------------------------------------------------- pin against the source
+# The mirrors above are only a spec while they match the shipped JS; pin
+# the literal expressions they transcribe so either side failing to move
+# in lockstep breaks the build.
+
+PINNED_SNIPPETS = [
+    # treeOrder
+    "return c.find(m => m.shared) || c[0];",
+    "const ts = s => s.timestamp || 1e18;",
+    "if (!seen.has(s)) out.push([s, 0]); // cycle leftovers",
+    # subtreeEnd
+    "while (j < curTree.length && curTree[j][1] > d) j++;",
+    # depGraph
+    "const names = all.slice(0, 48);",
+    "const cx = 400, cy = 250, R = Math.min(200, 60 + names.length * 8);",
+    "const a = 2 * Math.PI * i / names.length - Math.PI / 2;",
+    "const w = 0.8 + 3 * Math.log(1 + (l.callCount || 1)) / Math.log(1 + maxC);",
+    "stroke: l.errorCount ? '#b71c1c' : '#7986cb',",
+    "const tx = 0.09 * p[0] + 0.42 * mx + 0.49 * c[0],",
+    "fill: l.errorCount ? '#b71c1c' : '#3f51b5',",
+]
+
+
+def test_mirrors_pinned_to_shipped_app_js():
+    body, _ = ui.asset("app.js")
+    src = body.decode("utf-8")
+    for snippet in PINNED_SNIPPETS:
+        assert snippet in src, f"app.js drifted from spec mirror: {snippet!r}"
+
+

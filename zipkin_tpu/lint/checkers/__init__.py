@@ -15,11 +15,16 @@ Rule catalog (grounded in real past regressions — see ARCHITECTURE.md
   but lock-free in another.
 - ZT05 donation misuse: a donated argument read after the donating call.
 - ZT06 blocking sync: ``block_until_ready`` on serving paths.
+- ZT07 fresh-read ring sorts: sort/scan-family ops (or calls back into
+  the from-scratch ctx rebuilders) reachable from fresh-read
+  entrypoints — only the since-rollup delta segment may be sorted at
+  query time.
 """
 
 from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
     blocking,
     donation,
+    freshread,
     locks,
     pragmas,
     recompile,

@@ -1,0 +1,141 @@
+"""ZT07 — full-ring sorts on the fresh-read path.
+
+ISSUE 5's tentpole moved the O(R log R) link-context rebuild (a 4-key
+``lax.sort`` over 2^19 union lanes — 29.6 ms of the 41.3 ms r5 fresh
+read) off the query path: the sorted union order is maintained
+incrementally at rollup cadence, and a fresh read may sort only the
+since-rollup DELTA segment (``ops/delta_linker.py``). This rule is the
+regression fence: any sort/scan-family op — or a call back into the
+from-scratch rebuilders — reachable from a fresh-read entrypoint is a
+reintroduction of the full-ring cost and fails tier-1
+(tests/test_lint_clean.py).
+
+Mechanics: per module, functions named in ``FRESH_READ_ENTRYPOINTS``
+seed a call-graph walk over locally-defined functions (bare-name and
+attribute calls both descend when a local def matches — conservative:
+cross-module edges can't be followed, so each module on the path names
+its own entrypoint). Inside reachable functions two shapes are flagged:
+
+1. sort/scan-family calls: ``lax.sort``, ``jnp.sort``, ``jnp.argsort``,
+   ``jnp.lexsort``, ``lax.associative_scan``, ``lax.scan``.
+   ``jnp.cumsum`` is deliberately NOT in the set: prefix sums are the
+   delta formulation's own workhorse (compaction counting, run-id
+   assignment) and are O(n) elementwise-cheap vectorized ops — the
+   hazard this rule fences is the O(n log n) comparison sort and the
+   sequential carry loop, not parallel prefix.
+2. calls to the from-scratch rebuilders ``link_context`` /
+   ``resolve_parents`` (ops/linker.py): correct answers, wrong tier —
+   they are the rollup/oracle path.
+
+The ONE legitimate sort on the fresh path — the delta-segment sort in
+``delta_linker._resolve_core`` — carries a ZT07 pragma whose reason
+states the bound (``sorts only the 2·Δ delta-segment lanes``); the
+pragma-with-reason mechanism (ZT00) keeps that claim reviewable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from zipkin_tpu.lint.core import Checker, Module, register
+from zipkin_tpu.lint.taint import _root_name
+
+_FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# the query-path surface: functions that run (or build the program for)
+# a FRESH read — every module on the fresh path names its own entrypoint
+# because the walk cannot follow imports
+FRESH_READ_ENTRYPOINTS = {
+    "spmd_link_ctx",        # parallel/sharded.py: ctx-only program
+    "spmd_edges_fresh",     # parallel/sharded.py: fused ctx+edges program
+    "fresh_link_context",   # tpu/ingest.py: delta-read entrypoint
+    "delta_link_context",   # ops/delta_linker.py: resolve + chase + rules
+    "delta_resolve",        # ops/delta_linker.py: resolve only
+}
+
+# O(n log n) sorts and sequential-carry scans; jnp.cumsum is deliberately
+# absent (see module docstring)
+SORT_SCAN_ATTRS = {"sort", "argsort", "lexsort", "associative_scan", "scan"}
+SORT_SCAN_ROOTS = {"jax", "jnp", "lax"}
+
+# the from-scratch oracle surface (ops/linker.py)
+FULL_REBUILDERS = {"link_context", "resolve_parents"}
+
+
+def _callee_name(func: ast.AST):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+@register
+class FreshReadRingSort(Checker):
+    rule = "ZT07"
+    severity = "error"
+    name = "fresh-read-ring-sort"
+    doc = (
+        "sort/scan ops or from-scratch ctx rebuilds reachable from "
+        "fresh-read entrypoints"
+    )
+    hint = (
+        "fresh reads may only sort the since-rollup delta segment "
+        "(ops/delta_linker.py); move full-ring work to rollup cadence, "
+        "or suppress with a reason stating the delta-size bound"
+    )
+
+    def check(self, module: Module):
+        if not module.imported_roots & {"jax", "jnp"}:
+            return
+        defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, _FUNC_KINDS):
+                defs.setdefault(node.name, node)
+        roots = [d for n, d in defs.items() if n in FRESH_READ_ENTRYPOINTS]
+        if not roots:
+            return
+        # reachability over local defs (name-keyed, attribute calls
+        # included: over-approximate rather than miss a helper)
+        reached = {}  # def node -> entrypoint name that reaches it
+        stack = [(d, d.name) for d in roots]
+        while stack:
+            fn, root = stack.pop()
+            if fn.name in reached:
+                continue
+            reached[fn.name] = (fn, root)
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call):
+                    tgt = defs.get(_callee_name(call.func))
+                    if tgt is not None and tgt.name not in reached:
+                        stack.append((tgt, root))
+        for fn, root in reached.values():
+            yield from self._scan_function(module, fn, root)
+
+    def _scan_function(self, module: Module, fn: ast.AST, root: str):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and name in SORT_SCAN_ATTRS
+                and _root_name(node.func) in SORT_SCAN_ROOTS
+            ):
+                where = "" if fn.name == root else f" (via {fn.name}())"
+                yield self.found(
+                    module,
+                    node,
+                    f"{_root_name(node.func)}.{name} reachable from "
+                    f"fresh-read entrypoint {root}(){where} — fresh reads "
+                    "must not pay full-ring sort/scan cost",
+                )
+            elif name in FULL_REBUILDERS and fn.name not in FULL_REBUILDERS:
+                where = "" if fn.name == root else f" (via {fn.name}())"
+                yield self.found(
+                    module,
+                    node,
+                    f"from-scratch rebuilder {name}() called from "
+                    f"fresh-read entrypoint {root}(){where} — use the "
+                    "incremental delta formulation",
+                )

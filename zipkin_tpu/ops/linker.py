@@ -424,9 +424,29 @@ def link_context(x: LinkInput) -> LinkContext:
     full traces whose span timestamps intersect the window, SURVEY.md
     §3.5).
 
+    This is the FROM-SCRATCH formulation (full union sort + run-min
+    ladder): the oracle the incremental delta path
+    (ops/delta_linker.py) must match bit-for-bit, and the reference
+    every parity test fuzzes against. Production fresh reads ride the
+    delta formulation; this one remains the ground truth.
     """
     parent, has_child = resolve_parents(x)
     anc, root_ok = chase_ancestors(parent, jnp.where(x.valid, x.kind, 0))
+    return apply_rules(x, parent, has_child, anc, root_ok)
+
+
+def apply_rules(
+    x: LinkInput,
+    parent: jnp.ndarray,
+    has_child: jnp.ndarray,
+    anc: jnp.ndarray,
+    root_ok: jnp.ndarray,
+) -> LinkContext:
+    """The pure elementwise rule half of :func:`link_context`: turn a
+    resolved tree (parent rows, child marks, nearest-RPC ancestors,
+    root reachability) into per-lane edge candidates. Shared verbatim by
+    the from-scratch resolve and the incremental delta resolve so the
+    two can only diverge in tree resolution, never in rule semantics."""
     anc_svc = jnp.where(anc >= 0, x.svc[jnp.where(anc >= 0, anc, 0)], 0)
 
     local, remote = x.svc, x.rsvc
@@ -530,18 +550,30 @@ def link_window_bucketed(
     time-bucket ``slot[i]`` of its OWN timestamp — the device form of the
     reference's per-day dependency rollup (links attributed to the day of
     the child span, SURVEY.md §2.3 cassandra ``dependency`` table)."""
-    par_svc, child_svc, main_ok, main_err, anc_svc, local, back_ok = link_edges(
-        x, emit
+    return emit_links_bucketed(
+        link_context(x), slot, num_slots, emit, num_services
     )
+
+
+def emit_links_bucketed(
+    ctx: LinkContext,
+    slot: jnp.ndarray,
+    num_slots: int,
+    emit: jnp.ndarray,
+    num_services: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The cheap scatter half of :func:`link_window_bucketed` against a
+    precomputed context — the rollup reuses the incremental advance's
+    resolve instead of paying a second from-scratch link_context."""
     s = num_services
     d = jnp.clip(slot.astype(jnp.int32), 0, num_slots - 1)
     calls = jnp.zeros((num_slots, s, s), jnp.uint32)
     errors = jnp.zeros((num_slots, s, s), jnp.uint32)
-    pc = jnp.clip(par_svc, 0, s - 1)
-    cc = jnp.clip(child_svc, 0, s - 1)
-    calls = calls.at[d, pc, cc].add(main_ok.astype(jnp.uint32))
-    errors = errors.at[d, pc, cc].add(main_err.astype(jnp.uint32))
-    bc = jnp.clip(anc_svc, 0, s - 1)
-    lc = jnp.clip(local, 0, s - 1)
-    calls = calls.at[d, bc, lc].add(back_ok.astype(jnp.uint32))
+    pc = jnp.clip(ctx.par_svc, 0, s - 1)
+    cc = jnp.clip(ctx.child_svc, 0, s - 1)
+    calls = calls.at[d, pc, cc].add((ctx.ok & emit).astype(jnp.uint32))
+    errors = errors.at[d, pc, cc].add((ctx.err & emit).astype(jnp.uint32))
+    bc = jnp.clip(ctx.anc_svc, 0, s - 1)
+    lc = jnp.clip(ctx.local, 0, s - 1)
+    calls = calls.at[d, bc, lc].add((ctx.back & emit).astype(jnp.uint32))
     return calls, errors

@@ -637,10 +637,21 @@ class ZipkinServer:
         if restore:
             for name, value in restore.items():
                 out[f"gauge.zipkin_tpu.{name}"] = value
+        # incremental link-ctx gauges (ISSUE 5): since-rollup delta size,
+        # advance count, and host wall of the last ctx-advancing dispatch
+        counters = None
+        if hasattr(self.storage, "ingest_counters"):
+            counters = await asyncio.to_thread(self.storage.ingest_counters)
+            for name in ("ctxDeltaLanes", "ctxAdvances", "ctxMaintenanceMs"):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
         # sampling-tier gauges (ISSUE 4): retention verdict tallies, the
         # controller's budget posture, and the live per-service keep rate
         if getattr(self.storage, "sampler", None) is not None:
-            counters = await asyncio.to_thread(self.storage.ingest_counters)
+            if counters is None:
+                counters = await asyncio.to_thread(
+                    self.storage.ingest_counters
+                )
             for name in (
                 "sampledKept", "sampledDropped", "budgetUtilization",
                 "samplerPublishes", "samplerPressure",

@@ -17,7 +17,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from zipkin_tpu.ops import hashing, histogram, hll, linker, tdigest
+from zipkin_tpu.ops import delta_linker, hashing, histogram, hll, linker, tdigest
 from zipkin_tpu.tpu.columnar import SpanColumns
 from zipkin_tpu.tpu.state import (
     CTR_BATCHES,
@@ -144,6 +144,9 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
         r_keep=r_keep,
         r_rolled=put(state.r_rolled, jnp.zeros((n,), bool)),
         ring_pos=(state.ring_pos + live) % config.ring_capacity,
+        # incremental-ctx watermark: the rollup cadence guarantees this
+        # never exceeds rollup_segment before the next ctx advance
+        ctx_delta=state.ctx_delta + live,
         counters=counters,
     )
     return new_state
@@ -258,6 +261,27 @@ def ring_link_input(state: AggState) -> linker.LinkInput:
     )
 
 
+def ctx_struct(state: AggState) -> delta_linker.CtxStruct:
+    """View the persistent incremental-ctx leaves as a CtxStruct."""
+    return delta_linker.CtxStruct(
+        order=state.ctx_order, keys=state.ctx_keys,
+        rid_c=state.ctx_rid_c, rid_f=state.ctx_rid_f, inv=state.ctx_inv,
+        safe_sh=state.ctx_safe_sh, safe_ns=state.ctx_safe_ns,
+        safe_fsh=state.ctx_safe_fsh,
+        pos=state.ctx_pos, delta=state.ctx_delta,
+    )
+
+
+def fresh_link_context(config: AggConfig, state: AggState) -> linker.LinkContext:
+    """The fresh-read link context via the incremental delta formulation:
+    persistent ctx + since-advance delta segment, bit-identical to
+    ``linker.link_context(ring_link_input(state))`` (the from-scratch
+    oracle) but without any full-ring sort."""
+    return delta_linker.delta_link_context(
+        ring_link_input(state), ctx_struct(state), config.rollup_segment
+    )
+
+
 def rollup_step(config: AggConfig, state: AggState) -> AggState:
     """Link the half-ring the cursor will overwrite next and fold the
     edges into per-time-bucket rollup matrices, then mark those lanes
@@ -272,6 +296,11 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
     The host dispatches this before writes since the last rollup exceed
     ``config.rollup_segment`` (see ShardedAggregator.ingest), so no valid
     span is ever overwritten without its links being preserved.
+
+    ISSUE 5: this is also where the persistent incremental link ctx
+    ADVANCES — the delta-merge resolve doubles as the rollup's emit
+    context (one resolve serves both), and the refreshed ctx is what
+    makes the next fresh read pay only its own since-rollup delta.
     """
     x = ring_link_input(state)
     # x.seq is age-since-cursor: the lanes the cursor will overwrite next
@@ -286,8 +315,11 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
         d, state.rollup_epoch, slot, bucket_abs, to_roll
     )
 
-    calls_d, errs_d = linker.link_window_bucketed(
-        x, config.max_services, slot, d, emit
+    cs, parent, anc, root_ok, ctx = delta_linker.advance(
+        x, ctx_struct(state), config.rollup_segment
+    )
+    calls_d, errs_d = linker.emit_links_bucketed(
+        ctx, slot, d, emit, config.max_services
     )
     rollup_calls = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_calls)
     rollup_errs = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_errs)
@@ -300,6 +332,12 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
         # a live child written shortly after its parent rolled still
         # resolves full tree context at query or rollup time
         r_rolled=state.r_rolled | to_roll,
+        ctx_order=cs.order, ctx_keys=cs.keys,
+        ctx_rid_c=cs.rid_c, ctx_rid_f=cs.rid_f, ctx_inv=cs.inv,
+        ctx_safe_sh=cs.safe_sh, ctx_safe_ns=cs.safe_ns,
+        ctx_safe_fsh=cs.safe_fsh,
+        ctx_parent=parent, ctx_anc=anc, ctx_root=root_ok,
+        ctx_pos=cs.pos, ctx_delta=cs.delta,
     )
 
 

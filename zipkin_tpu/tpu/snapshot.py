@@ -60,7 +60,11 @@ _STATE_PREFIX = "sketch_state-"
 # v2 = r2 retention layout (hist_t/rollup leaves, retention config keys).
 # v3 = sampling tier (s_rate/s_tail/s_link tables, r_keep ring column,
 #      sampling/sample_rare_min config keys).
-SNAPSHOT_VERSION = 3
+# v4 = persistent incremental link ctx (ctx_* leaves: sorted union
+#      order/keys/runs/safe-candidates + resolved tree + watermark
+#      cursor) — resumed ctx must be bit-identical, so it rides the
+#      snapshot like every other leaf.
+SNAPSHOT_VERSION = 4
 
 
 def _fsync_dir(directory: str) -> None:

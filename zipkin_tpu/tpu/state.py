@@ -162,6 +162,25 @@ class AggState(NamedTuple):
     s_rate: jnp.ndarray  # u32 [S] — per-service keep rate, 65536 = keep all
     s_tail: jnp.ndarray  # u32 [K] — per-key tail-latency threshold (µs)
     s_link: jnp.ndarray  # u32 [S, S] — published (svc, rsvc) edge counts
+    # persistent incremental link context (ops/delta_linker.py): the
+    # sorted join-union order over the ring, its run decomposition, the
+    # per-run first-wins candidates restricted to lanes that cannot be
+    # overwritten before the next advance, and the resolved tree at the
+    # last advance. Advanced at rollup cadence; a fresh dependency read
+    # pays only the since-advance delta segment against these.
+    ctx_order: jnp.ndarray  # i32 [2R] union index per sorted position
+    ctx_keys: jnp.ndarray  # u32 [4, 2R] sort-key snapshot per position
+    ctx_rid_c: jnp.ndarray  # i32 [2R] coarse run id (1-based)
+    ctx_rid_f: jnp.ndarray  # i32 [2R] fine run id (1-based)
+    ctx_inv: jnp.ndarray  # i32 [2R] sorted position of union entry u
+    ctx_safe_sh: jnp.ndarray  # i32 [2R] first safe shared lane per run
+    ctx_safe_ns: jnp.ndarray  # i32 [2R] first safe non-shared lane per run
+    ctx_safe_fsh: jnp.ndarray  # i32 [2R] first safe shared lane, fine run
+    ctx_parent: jnp.ndarray  # i32 [R] resolved parent lane at the advance
+    ctx_anc: jnp.ndarray  # i32 [R] nearest-RPC-ancestor lane at the advance
+    ctx_root: jnp.ndarray  # bool [R] parent chain reaches a root
+    ctx_pos: jnp.ndarray  # i32 scalar — covered-watermark lane cursor
+    ctx_delta: jnp.ndarray  # i32 scalar — lanes written since the advance
     counters: jnp.ndarray  # u32 [NUM_COUNTERS]
 
 
@@ -208,6 +227,24 @@ def init_state(config: AggConfig) -> AggState:
         s_link=jnp.zeros(
             (config.max_services, config.max_services), jnp.uint32
         ),
+        # incremental link ctx of the all-invalid ring (every union key
+        # 0xFFFFFFFF -> identity order is validly sorted, one run, no
+        # candidates) — exactly what an advance over the empty ring
+        # yields, so the first real advance is indistinguishable from
+        # one that followed an earlier empty advance
+        ctx_order=jnp.arange(2 * r, dtype=jnp.int32),
+        ctx_keys=jnp.full((4, 2 * r), 0xFFFFFFFF, jnp.uint32),
+        ctx_rid_c=jnp.ones((2 * r,), jnp.int32),
+        ctx_rid_f=jnp.ones((2 * r,), jnp.int32),
+        ctx_inv=jnp.arange(2 * r, dtype=jnp.int32),
+        ctx_safe_sh=jnp.full((2 * r,), -1, jnp.int32),
+        ctx_safe_ns=jnp.full((2 * r,), -1, jnp.int32),
+        ctx_safe_fsh=jnp.full((2 * r,), -1, jnp.int32),
+        ctx_parent=jnp.full((r,), -1, jnp.int32),
+        ctx_anc=jnp.full((r,), -1, jnp.int32),
+        ctx_root=jnp.ones((r,), bool),
+        ctx_pos=jnp.zeros((), jnp.int32),
+        ctx_delta=jnp.zeros((), jnp.int32),
         counters=jnp.zeros((NUM_COUNTERS,), jnp.uint32),
     )
 

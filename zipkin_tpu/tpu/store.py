@@ -1176,6 +1176,13 @@ class TpuStorage(
             "hostTransfers": self.agg.read_stats["host_transfers"],
             "rolledOnlyReads": self.agg.read_stats["rolled_only_reads"],
             "ctxReads": self.agg.read_stats["ctx_reads"],
+            # incremental link-ctx gauges (ISSUE 5): lanes the next
+            # fresh read must delta-merge (bounded by rollup_segment),
+            # ctx advances run, and the host wall of the last
+            # ctx-advancing (rollup-fused) dispatch
+            "ctxDeltaLanes": self.agg._lanes_since_rollup,
+            "ctxAdvances": self.agg.ctx_stats["ctx_advances"],
+            "ctxMaintenanceMs": self.agg.ctx_stats["ctx_maintenance_ms"],
             # HLL envelope guard: reads that saw a bias-dominated row /
             # rows beyond at the last read (both 0 in healthy operation)
             "hllEnvelopeExceeded": self._hll_envelope_exceeded,
