@@ -94,8 +94,15 @@ def main() -> None:
     # the workers and the PJRT client time-slice the same CPU);
     # "sampling": the json path with the tail-sampling tier armed at a
     # ~50% drop rate (ISSUE 4) — the delta vs "json" is the verdict +
-    # host-gating overhead (benchmarks/sampling_bench.py decomposes it).
+    # host-gating overhead (benchmarks/sampling_bench.py decomposes it);
+    # "obs": flight-recorder on/off A/B through the server's null-sink
+    # boundary leg (ISSUE 6 — benchmarks/obs_overhead.py owns it).
     mode = os.environ.get("BENCH_MODE", "json")
+    if mode == "obs":
+        from benchmarks.obs_overhead import main as obs_main
+
+        obs_main()
+        return
     # adversarial corpus (VERDICT r2 order 8): unique spans streamed
     # without recycling, service/name cardinality beyond vocab capacity
     # (overflow path live), large tags on 1-in-7 spans. Reported in the

@@ -1,0 +1,86 @@
+"""Flight-recorder overhead A/B: recorder-on vs recorder-off ingest.
+
+The acceptance bar for the obs tier is < 2% overhead on server-level
+ingest (ISSUE 6). This harness reuses server_bench's ``null``-sink leg —
+HTTP handling, body read, format sniff, collector dispatch, thread hop,
+with ``ingest_json_fast`` returning immediately — because that boundary
+leg has the *highest* record-calls-per-unit-work ratio: every stage
+record the obs tier adds is still on the path, but none of the parse or
+device work that would amortize it. An overhead number that holds on the
+null sink holds a fortiori on the full path.
+
+Two identical legs run back to back (``TPU_OBS`` state flipped on the
+process-global recorder between them), plus the recorder's own
+microbenchmark (ns per ``record()`` against a scratch instance).
+
+Run from the repo root: ``python -m benchmarks.obs_overhead``
+(OBS_BENCH_SPANS, OBS_BENCH_PORT) or ``BENCH_MODE=obs python bench.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+
+async def run() -> dict:
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu import obs
+    from zipkin_tpu.model import json_v2
+
+    from benchmarks.server_bench import _run_leg
+
+    total = int(os.environ.get("OBS_BENCH_SPANS", 500_000))
+    port = int(os.environ.get("OBS_BENCH_PORT", 19519))
+    batch = 65_536
+
+    spans = lots_of_spans(2 * batch, seed=7, services=40, span_names=120)
+    payloads = [
+        json_v2.encode_span_list(spans[i : i + batch])
+        for i in range(0, len(spans), batch)
+    ]
+
+    # Alternating on/off pairs, best pass per side — the same convention
+    # bench.py uses for its phase-variant backend: a single pair showed
+    # ±10% run-to-run noise that swamps the recorder's real cost (the
+    # sign even flips between back-to-back pairs), while best-of
+    # converges because the noise is strictly additive.
+    pairs = int(os.environ.get("OBS_BENCH_PAIRS", 3))
+    was_enabled = obs.RECORDER.enabled
+    best = {"on": 0.0, "off": 0.0}
+    try:
+        i = 0
+        for _ in range(pairs):
+            # recorder-on leads each pair, so one-time warmup (imports,
+            # sockets) biases AGAINST the recorder, never for it
+            for label, on in (("on", True), ("off", False)):
+                obs.RECORDER.set_enabled(on)
+                leg = await _run_leg(
+                    "null", "json", port + i, 0, payloads, batch, total
+                )
+                i += 1
+                best[label] = max(best[label], leg["spans_per_sec"])
+    finally:
+        obs.RECORDER.set_enabled(was_enabled)
+
+    overhead_pct = (best["off"] - best["on"]) / best["off"] * 100.0
+    return {
+        "metric": "obs_recorder_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "% of null-sink ingest throughput",
+        "spans_per_sec_recorder_off": best["off"],
+        "spans_per_sec_recorder_on": best["on"],
+        "record_ns_each": round(obs.RECORDER.measure_overhead(), 1),
+        "spans_per_leg": total,
+        "pairs": pairs,
+        "target": "< 2% (ISSUE 6 acceptance)",
+    }
+
+
+def main() -> None:
+    print(json.dumps(asyncio.run(run())), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -165,6 +165,38 @@ def main() -> None:
         )
         walls[name] = xs
 
+    # -- flight-recorder cross-check (ISSUE 6) ---------------------------
+    # Store-level fresh reads travel _cached_read, which records the
+    # query_fresh stage; the recorder's p50 must agree with the wall
+    # this harness measures for the same calls — within log2-bucket
+    # resolution (the reported bound is < 2x above the true value).
+    from zipkin_tpu import obs
+
+    obs.RECORDER.reset()  # quiesced: ingest finished, reads are serial
+    end_ts_ms = hi_min * 60_000
+    store_walls = []
+    for _ in range(reps):
+        store.invalidate_read_cache()  # every rep takes the fresh path
+        t1 = time.perf_counter()
+        store.get_dependencies(end_ts_ms, end_ts_ms).execute()
+        store_walls.append((time.perf_counter() - t1) * 1e3)
+    rec_fresh = obs.RECORDER.snapshot().stage("query_fresh")
+    wall_p50 = _stats(store_walls)["p50"]
+    rec_p50 = rec_fresh.p50_us / 1e3
+    recorder_report = {
+        "store_fresh_read_wall_ms": _stats(store_walls),
+        "recorder_query_fresh_p50_ms": round(rec_p50, 3),
+        "recorder_query_fresh_p99_ms": round(rec_fresh.p99_us / 1e3, 3),
+        "recorder_query_fresh_count": rec_fresh.count,
+        # a fresh dependency read is one _cached_read miss (the edges
+        # pull) that dominates the wall, so the recorder's p50 tracks
+        # the harness number from inside the pipeline — the log2 bucket
+        # bound and the harness's own call overhead set the window
+        "agrees_with_wall": bool(
+            rec_fresh.count >= reps and 0.25 * wall_p50 <= rec_p50 <= 1.25 * wall_p50
+        ),
+    }
+
     # -- legacy (3-pull) vs packed (1-pull) dependency-edge A/B ----------
     # The raw (pre-pack) program still compiles; pulling its three
     # arrays separately is exactly the pre-change read path. Parity must
@@ -305,6 +337,7 @@ def main() -> None:
         },
         "reads_transfers_per_query": transfers,
         "reads_wall_over_device": wall_over_device,
+        "flight_recorder": recorder_report,
         "dependency_edges_transfer_ab": edges_ab,
         "program_device_ms_per_dispatch": program_ms,
         "incremental_ctx": ctx_report,

@@ -29,6 +29,10 @@ into its layers —
 and prints per-span µs for boundary / parse / feed as a table plus one
 JSON line. MP workers are forced off here: the decomposition targets
 the in-process path (workers would move parse off the timed core).
+DECOMPOSE is the offline A/B splitter; since the obs tier landed it is
+no longer the only stage-timing source — the in-process flight
+recorder (zipkin_tpu/obs, surfaced at /api/v2/tpu/statusz) times the
+same stages continuously in production.
 
 Run from the repo root: ``python -m benchmarks.server_bench``
 (SERVER_BENCH_SPANS, SERVER_BENCH_MP_WORKERS, SERVER_BENCH_FORMAT).

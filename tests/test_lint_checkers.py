@@ -512,3 +512,115 @@ def test_unparsable_file_is_an_error_not_a_crash(tmp_path):
     result = run_paths([str(bad)], root=tmp_path)
     assert result.exit_code == 1
     assert result.errors and "bad.py" in result.errors[0]
+
+
+# -- ZT08: obs stage discipline -----------------------------------------
+
+
+ZT08_JIT_POSITIVE = """
+    import jax
+    from zipkin_tpu import obs
+
+    @jax.jit
+    def step(x):
+        obs.record("pack", 0.001)
+        return x
+"""
+
+
+def test_zt08_flags_record_inside_jitted_def(tmp_path):
+    assert_rule_owned(tmp_path, ZT08_JIT_POSITIVE, "ZT08")
+
+
+def test_zt08_flags_record_reachable_from_traced_code(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu import obs
+
+        def _note(x):
+            obs.record("pack", 0.001)
+            return x
+
+        def kernel(x):
+            return _note(x)
+
+        run = jax.jit(kernel)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_unknown_stage_name(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        from zipkin_tpu import obs
+
+        def serve():
+            obs.record("warp_drive", 0.1)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_non_literal_stage(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        from zipkin_tpu import obs
+
+        def serve(name):
+            obs.record(name, 0.1)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_recognizes_bare_record_import(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        from zipkin_tpu.obs import record
+
+        def serve():
+            record("nope", 0.1)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_clean_host_side_taxonomy_record(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu import obs
+        from zipkin_tpu.obs import RECORDER
+
+        def serve(x):
+            obs.record("query_fresh", 0.1)
+            RECORDER.record("wal_append", 0.05)
+            return x
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt08_ignores_unrelated_record_methods(tmp_path):
+    # a .record attribute on some other object is not the obs recorder
+    result = lint(
+        tmp_path,
+        """
+        import zipkin_tpu
+
+        def serve(vcr):
+            vcr.record("anything", 0.1)
+        """,
+    )
+    assert rules(result) == []

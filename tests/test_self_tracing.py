@@ -83,3 +83,42 @@ def test_ingest_traced_alongside_real_spans():
         assert len(trace) == len(TRACE)
 
     _run(scenario)
+
+
+def test_b3_sampled_zero_suppresses_self_span():
+    """B3 spec: the caller's no-sample decision propagates — an incoming
+    X-B3-Sampled: 0 suppresses the self-span even at local rate 1.0."""
+    async def scenario(client, server):
+        resp = await client.get(
+            "/api/v2/services", headers={"X-B3-Sampled": "0"}
+        )
+        assert resp.status == 200
+        assert await _self_spans(server, tries=6) == []
+
+    _run(scenario)
+
+
+def test_b3_sampled_one_forces_past_local_rate():
+    """X-B3-Sampled: 1 (and the debug flag 'd') force recording even
+    when the local sampler would drop everything."""
+    async def scenario(client, server):
+        resp = await client.get(
+            "/api/v2/services", headers={"X-B3-Sampled": "1"}
+        )
+        assert resp.status == 200
+        spans = await _self_spans(server)
+        assert spans, "forced-sample request was not recorded"
+
+    _run(scenario, self_tracing_sample_rate=0.0)
+
+
+def test_garbage_sampled_header_falls_back_to_local_rate():
+    async def scenario(client, server):
+        resp = await client.get(
+            "/api/v2/services", headers={"X-B3-Sampled": "maybe"}
+        )
+        assert resp.status == 200
+        spans = await _self_spans(server)
+        assert spans  # local rate is 1.0
+
+    _run(scenario)

@@ -1,11 +1,14 @@
 """Server E2E with STORAGE_TYPE=tpu: the BASELINE config[0] smoke test
-through the device tier, plus the sketch-extension endpoints.
+through the device tier, plus the sketch-extension endpoints and the
+flight-recorder surfaces (/prometheus histograms, /statusz, slow-span
+dogfooding).
 
 Mirrors ITZipkinServer (SURVEY.md §4) but with the TPU storage wired via
 the same autoconfig seam the reference uses (STORAGE_TYPE env).
 """
 
 import asyncio
+import re
 
 from aiohttp.test_utils import TestClient, TestServer
 
@@ -188,3 +191,194 @@ class TestTpuServer:
                 await server.stop()  # drains + closes the MP tier
 
         asyncio.run(scenario_factory())
+
+
+# -- flight recorder surfaces (zipkin_tpu.obs) ---------------------------
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_META = re.compile(rf"^# (HELP|TYPE) ({_PROM_NAME})(?: (.*))?$")
+_PROM_SAMPLE = re.compile(
+    rf"^({_PROM_NAME})(?:\{{((?:[a-zA-Z_][a-zA-Z0-9_]*="
+    rf'"(?:[^"\\]|\\.)*",?)*)\}})? (.+)$'
+)
+
+
+def _assert_valid_prometheus(text):
+    """Exposition-format validity: every line parses as metadata or a
+    sample, names stay inside the legal charset (no dots), every sample
+    belongs to a family that declared # HELP and # TYPE."""
+    helped, typed = set(), {}
+    samples = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        m = _PROM_META.match(line)
+        if m:
+            kind, name = m.group(1), m.group(2)
+            if kind == "HELP":
+                helped.add(name)
+            else:
+                typed[name] = (m.group(3) or "").strip()
+            continue
+        m = _PROM_SAMPLE.match(line)
+        assert m, f"unparsable exposition line: {line!r}"
+        name, value = m.group(1), m.group(3)
+        float(value)  # must parse
+        samples.append(name)
+    assert samples, "empty exposition"
+    for name in samples:
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and typed.get(base) == "histogram":
+                fam = base
+                break
+        assert fam in typed, f"sample {name} missing # TYPE"
+        assert fam in helped, f"sample {name} missing # HELP"
+    return samples
+
+
+class TestFlightRecorder:
+    def test_prometheus_exposition_format_valid(self):
+        async def scenario(client):
+            resp = await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 202
+            text = await (await client.get("/prometheus")).text()
+            samples = _assert_valid_prometheus(text)
+            assert all("." not in s for s in samples)
+            # the stage histogram family is present and native-shaped
+            fam = "zipkin_tpu_stage_latency_seconds"
+            assert f"# TYPE {fam} histogram" in text
+            stages = {}
+            for line in text.splitlines():
+                m = re.match(
+                    rf'^{fam}_bucket\{{stage="([a-z_]+)",le="([^"]+)"\}} '
+                    rf"(\d+)$",
+                    line,
+                )
+                if m:
+                    stages.setdefault(m.group(1), []).append(
+                        (float(m.group(2)), int(m.group(3)))
+                    )
+            assert "parse" in stages  # this POST decoded spans
+            counts = {
+                m.group(1): int(m.group(2))
+                for m in re.finditer(
+                    rf'{fam}_count\{{stage="([a-z_]+)"\}} (\d+)', text
+                )
+            }
+            for stage, rows in stages.items():
+                les = [le for le, _ in rows]
+                cums = [c for _, c in rows]
+                assert les == sorted(les), (stage, les)
+                assert cums == sorted(cums), (stage, cums)
+                assert les[-1] == float("inf")
+                # _count agrees with the +Inf bucket
+                assert counts[stage] == cums[-1], stage
+            assert f'{fam}_sum{{stage="parse"}}' in text
+
+        run(scenario)
+
+    def test_statusz_debug_plane(self):
+        async def scenario(client):
+            resp = await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 202
+            resp = await client.get("/api/v2/tpu/statusz")
+            assert resp.status == 200
+            body = await resp.json()
+            from zipkin_tpu.obs import STAGES
+
+            assert set(body["stages"]) == set(STAGES)
+            st = body["stages"]
+            assert st["parse"]["count"] > 0
+            assert st["pack"]["count"] > 0
+            assert st["http_boundary"]["count"] > 0
+            for row in st.values():
+                assert row["p50Us"] <= row["p99Us"] <= row["maxUs"]
+                assert row["budgetUs"] != 0  # real budget (or -1 = inf)
+            rec = body["recorder"]
+            assert rec["enabled"] is True
+            assert rec["overheadNsPerRecord"] > 0
+            assert rec["writerThreads"] >= 1
+            assert isinstance(body["slow"], list)
+
+        run(scenario)
+
+    def test_slow_stage_dogfoods_self_span(self):
+        """Acceptance: a deliberately slowed stage (budget scale 0 puts
+        every stage over budget) produces a zipkin-tpu-pipeline span
+        retrievable from the server's OWN store via /api/v2/trace/{id},
+        B3-linked to the enclosing HTTP request's self-trace."""
+        trace_id = "00000000000000ce"
+
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=2)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    self_tracing_enabled=True,
+                    obs_selfspans_enabled=True,
+                    obs_budget_scale=0.0,
+                ),
+                storage=storage,
+            )
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                    headers={
+                        "Content-Type": "application/json",
+                        "X-B3-TraceId": trace_id,
+                        "X-B3-SpanId": "00000000000000ab",
+                    },
+                )
+                assert resp.status == 202
+                got = []
+                for _ in range(60):
+                    resp = await client.get(f"/api/v2/trace/{trace_id}")
+                    if resp.status == 200:
+                        got = [
+                            s for s in await resp.json()
+                            if s.get("localEndpoint", {}).get("serviceName")
+                            == "zipkin-tpu-pipeline"
+                        ]
+                        if got:
+                            break
+                    await asyncio.sleep(0.05)
+                assert got, "no pipeline self-span joined the request trace"
+                span = got[0]
+                assert span["name"] in ("http_boundary", "parse", "pack")
+                assert span["tags"]["obs.stage"] == span["name"]
+                assert span["duration"] >= 1
+                # /statusz shows the enriched slow event with its B3 link;
+                # the emitted counter lands after accept() returns, so poll
+                linked, emitted = False, 0
+                for _ in range(40):
+                    body = await (
+                        await client.get("/api/v2/tpu/statusz")
+                    ).json()
+                    assert body["recorder"]["selfSpans"] is True
+                    linked = linked or any(
+                        e.get("traceId") == trace_id for e in body["slow"]
+                    )
+                    emitted = body["recorder"]["selfSpansEmitted"]
+                    if linked and emitted >= 1:
+                        break
+                    await asyncio.sleep(0.05)
+                assert linked, "slow ring lost the B3-linked event"
+                assert emitted >= 1
+            finally:
+                await client.close()
+                await server.stop()  # restores global recorder state
+            from zipkin_tpu import obs
+
+            assert obs.RECORDER.budget_scale == 1.0  # scale restored
+
+        asyncio.run(wrapper())

@@ -18,8 +18,10 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from zipkin_tpu import obs
 from zipkin_tpu.model import codec
 from zipkin_tpu.model.span import Span
 from zipkin_tpu.storage.spi import StorageComponent
@@ -205,7 +207,9 @@ class Collector:
             except ValueError:
                 pass  # fall through: the python codec owns error reporting
         try:
+            t0 = time.perf_counter()
             spans = codec.decode_spans(data, encoding)
+            obs.record("parse", time.perf_counter() - t0)
         except Exception as e:
             self.metrics.increment_messages_dropped()
             raise ValueError(f"cannot decode spans: {e}") from e

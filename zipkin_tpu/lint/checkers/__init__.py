@@ -19,6 +19,10 @@ Rule catalog (grounded in real past regressions — see ARCHITECTURE.md
   the from-scratch ctx rebuilders) reachable from fresh-read
   entrypoints — only the since-rollup delta segment may be sorted at
   query time.
+- ZT08 obs stage discipline: ``obs.record`` reachable from
+  device-traced code (host instrumentation runs once at trace time),
+  or a stage argument outside the closed taxonomy in
+  ``obs/stages.py``.
 """
 
 from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
@@ -26,6 +30,7 @@ from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
     donation,
     freshread,
     locks,
+    obsstage,
     pragmas,
     recompile,
     transfers,

@@ -1,0 +1,197 @@
+"""ZT08 — flight-recorder stage discipline.
+
+The obs tier (``zipkin_tpu/obs``) is host-side instrumentation with a
+CLOSED stage taxonomy (``obs.stages.STAGES``): dashboards, budgets, and
+the /statusz schema key off the fixed name set, and the recorder indexes
+histograms by ``STAGE_INDEX`` — an unknown name is a hot-path KeyError.
+Two shapes are flagged:
+
+1. ``record()`` reachable from device-traced code. ``obs.record`` is
+   Python host code (thread-local lists, a seqlock counter): inside a
+   ``jax.jit``/``shard_map`` region it would execute once at trace time
+   — recording a single bogus near-zero sample, then silently never
+   again — or fail outright under tracing. Traced defs are those
+   decorated with (or passed to) ``jax.jit``/``shard_map``, plus
+   everything they reach through locally-defined helpers (the ZT07
+   reachability shape: attribute calls descend too, over-approximating
+   rather than missing a helper).
+2. A ``record()`` stage argument that is not a string literal from the
+   taxonomy. Literal-only keeps every stage name greppable and lets
+   this rule verify membership statically; a dynamic stage would also
+   dodge the budget table. To add a stage, extend ``obs/stages.py``
+   (name + budget) — see its docstring — and this rule learns it
+   automatically.
+
+Recognized record shapes: ``obs.record(...)``, ``RECORDER.record(...)``,
+``obs.RECORDER.record(...)``, and a bare ``record(...)`` when the module
+imports it ``from zipkin_tpu.obs import record``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from zipkin_tpu.lint.core import Checker, Module, register
+from zipkin_tpu.lint.taint import _root_name
+from zipkin_tpu.obs.stages import STAGES
+
+_FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_RECORD_ROOTS = {"obs", "RECORDER"}
+_TRACE_NAMES = {"jit", "shard_map"}
+
+
+def _is_trace_call(node: ast.AST) -> bool:
+    """jax.jit(...), jit(...), shard_map(...), or a partial over one."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _TRACE_NAMES:
+        return True
+    if isinstance(f, ast.Name) and f.id in _TRACE_NAMES:
+        return True
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr == "partial"
+        and node.args
+        and _is_trace_call(ast.Call(func=node.args[0], args=[], keywords=[]))
+    ):
+        return True
+    return False
+
+
+def _callee_name(func: ast.AST):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+@register
+class ObsStageDiscipline(Checker):
+    rule = "ZT08"
+    severity = "error"
+    name = "obs-stage-discipline"
+    doc = (
+        "obs.record inside device-traced code; stage args outside the "
+        "closed taxonomy"
+    )
+    hint = (
+        "record stages from host code only, with a string literal from "
+        "obs.stages.STAGES; to add a stage extend obs/stages.py"
+    )
+
+    def check(self, module: Module):
+        if "zipkin_tpu" not in module.imported_roots:
+            return
+        bare = self._bare_record_aliases(module)
+        records = [
+            node
+            for node in ast.walk(module.tree)
+            if self._is_record_call(node, bare)
+        ]
+        if not records:
+            return
+        yield from self._check_stage_args(module, records)
+        yield from self._check_traced_reach(module, bare)
+
+    # -- record-call recognition ------------------------------------------
+
+    def _bare_record_aliases(self, module: Module) -> set:
+        names = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "zipkin_tpu.obs":
+                for a in node.names:
+                    if a.name == "record":
+                        names.add(a.asname or a.name)
+        return names
+
+    def _is_record_call(self, node: ast.AST, bare: set) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "record":
+            return _root_name(f) in _RECORD_ROOTS
+        return isinstance(f, ast.Name) and f.id in bare
+
+    # -- shape 2: stage names come from the closed taxonomy ----------------
+
+    def _check_stage_args(self, module: Module, records):
+        for call in records:
+            arg = call.args[0] if call.args else None
+            if arg is None:
+                for kw in call.keywords:
+                    if kw.arg == "stage":
+                        arg = kw.value
+            if arg is None:
+                yield self.found(module, call, "record() call with no stage")
+                continue
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                yield self.found(
+                    module,
+                    call,
+                    "record() stage must be a string literal — dynamic "
+                    "names dodge the taxonomy and the budget table",
+                )
+                continue
+            if arg.value not in STAGES:
+                yield self.found(
+                    module,
+                    call,
+                    f"unknown stage {arg.value!r} — not in obs.stages."
+                    "STAGES (histograms/budgets/statusz key off the "
+                    "closed set)",
+                )
+
+    # -- shape 1: no recording inside device-traced code -------------------
+
+    def _check_traced_reach(self, module: Module, bare: set):
+        if not module.imported_roots & {"jax", "jnp"}:
+            return
+        defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, _FUNC_KINDS):
+                defs.setdefault(node.name, node)
+        traced = []
+        for fn in defs.values():
+            if any(_is_trace_call(d) or _trace_target(d) for d in fn.decorator_list):
+                traced.append(fn)
+        for node in ast.walk(module.tree):
+            if _is_trace_call(node):
+                for arg in node.args:
+                    tgt = defs.get(arg.id) if isinstance(arg, ast.Name) else None
+                    if tgt is not None:
+                        traced.append(tgt)
+        if not traced:
+            return
+        reached = {}  # name -> (def node, traced root name)
+        stack = [(d, d.name) for d in traced]
+        while stack:
+            fn, root = stack.pop()
+            if fn.name in reached:
+                continue
+            reached[fn.name] = (fn, root)
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call):
+                    tgt = defs.get(_callee_name(call.func))
+                    if tgt is not None and tgt.name not in reached:
+                        stack.append((tgt, root))
+        for fn, root in reached.values():
+            for node in ast.walk(fn):
+                if self._is_record_call(node, bare):
+                    where = "" if fn.name == root else f" (via {fn.name}())"
+                    yield self.found(
+                        module,
+                        node,
+                        f"obs.record inside device-traced {root}(){where} "
+                        "— host-side instrumentation runs once at trace "
+                        "time, then never again",
+                    )
+
+
+def _trace_target(dec: ast.AST) -> bool:
+    """Bare (non-call) jit/shard_map decorator: ``@jax.jit``/``@jit``."""
+    if isinstance(dec, ast.Attribute):
+        return dec.attr in _TRACE_NAMES
+    return isinstance(dec, ast.Name) and dec.id in _TRACE_NAMES

@@ -1,0 +1,35 @@
+"""Pipeline observability: flight recorder + slow-dispatch self-spans.
+
+``RECORDER`` is the process-wide stage recorder; instrumented hot paths
+call ``obs.record(stage, dur_s)`` with a stage-name literal from
+:mod:`zipkin_tpu.obs.stages` (lint rule ZT08 enforces both the literal
+and that no record call hides inside jit'd/device-traced code).
+Disable with ``TPU_OBS=0`` — every record becomes one predicate check.
+
+``selfspans`` is imported lazily by the server (it pulls in the span
+model); low-level modules importing ``obs`` pay only for the recorder.
+"""
+
+import os
+
+from zipkin_tpu.obs.stages import (  # noqa: F401
+    DEFAULT_BUDGETS_US,
+    NUM_STAGES,
+    STAGE_INDEX,
+    STAGES,
+)
+from zipkin_tpu.obs.recorder import (  # noqa: F401
+    NUM_BUCKETS,
+    Snapshot,
+    StageRecorder,
+    StageStat,
+    bucket_index,
+    bucket_le_us,
+)
+
+RECORDER = StageRecorder(
+    enabled=os.environ.get("TPU_OBS", "1").strip().lower()
+    not in ("0", "false", "no"),
+)
+
+record = RECORDER.record

@@ -1,0 +1,64 @@
+"""Fixed stage taxonomy for the pipeline flight recorder.
+
+Every ``obs.record(stage, dur_s)`` call site must name one of the
+stages below with a string literal (statically enforced by lint rule
+ZT08). The taxonomy is deliberately closed: a fixed, ordered tuple
+lets the recorder preallocate flat per-thread arrays indexed by stage,
+and dashboards can rely on the label set being stable across builds.
+
+To add a stage: append the name here, give it a budget in
+``DEFAULT_BUDGETS_US``, and instrument the host-side call site —
+never inside jit'd/shard_map'd code (ZT08 rejects that too). See
+ARCHITECTURE.md "Pipeline observability".
+
+Budgets are the slow-span thresholds in µs: an observation exceeding
+its stage budget lands in the recorder's slow-event ring and, when the
+self-span emitter is installed (``TPU_OBS_SELFSPANS=1``), is published
+as an internal span for service ``zipkin-tpu-pipeline``. Defaults are
+intentionally generous — they flag genuine stalls, not CPU-backend jit
+compiles in tests; scale them with ``TPU_OBS_BUDGET_SCALE``.
+"""
+
+STAGES = (
+    "http_boundary",     # request body read → collector hand-off (server side)
+    "parse",             # wire bytes → columnar/object spans (C parser or codec)
+    "pack",              # parsed spans → packed device wire image
+    "route",             # shard routing of a fused batch
+    "device_dispatch",   # enqueue wall of the jit'd ingest step (async dispatch)
+    "rollup",            # fused rollup dispatch wall (pre-eviction linking)
+    "ctx_advance",       # incremental link-context advance at query time
+    "wal_append",        # WAL record write incl. buffer flush
+    "wal_fsync",         # the fsync portion of a WAL append
+    "snapshot",          # device-state snapshot save + WAL truncate
+    "sampler_tick",      # RateController control-loop tick
+    "archive_write",     # disk archive / fast-sample append
+    "query_fresh",       # read-path cache miss: full device read program
+    "query_cached",      # read-path cache hit under the version check
+    "readpack_transfer",  # the single packed device→host pull per query
+    "mp_record",         # MP dispatcher: shm copy + remap + device feed
+)
+
+NUM_STAGES = len(STAGES)
+STAGE_INDEX = {name: i for i, name in enumerate(STAGES)}
+
+# Slow-span budgets, µs, scaled by TPU_OBS_BUDGET_SCALE at install time.
+DEFAULT_BUDGETS_US = {
+    "http_boundary": 500_000,
+    "parse": 250_000,
+    "pack": 250_000,
+    "route": 250_000,
+    "device_dispatch": 250_000,
+    "rollup": 1_000_000,
+    "ctx_advance": 500_000,
+    "wal_append": 100_000,
+    "wal_fsync": 100_000,
+    "snapshot": 5_000_000,
+    "sampler_tick": 100_000,
+    "archive_write": 250_000,
+    "query_fresh": 150_000,
+    "query_cached": 50_000,
+    "readpack_transfer": 100_000,
+    "mp_record": 500_000,
+}
+
+assert set(DEFAULT_BUDGETS_US) == set(STAGES)

@@ -23,7 +23,7 @@ except ImportError:  # jax < 0.6 ships it under experimental
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from zipkin_tpu import readpack
+from zipkin_tpu import obs, readpack
 from zipkin_tpu.ops import linker as dlink
 from zipkin_tpu.tpu import ingest as ing
 from zipkin_tpu.tpu.columnar import (
@@ -598,8 +598,11 @@ class ShardedAggregator:
         """Route one host batch across shards and fold it in (the batch
         ships as one fused u32 array — one transfer, not 17)."""
         live_ts = cols.ts_min[cols.valid]
+        t0 = time.perf_counter()
+        routed = route_fused(cols, self.n_shards)
+        obs.record("route", time.perf_counter() - t0)
         self.ingest_fused(
-            route_fused(cols, self.n_shards),
+            routed,
             n_spans=int(cols.valid.sum()),
             n_dur=int((cols.valid & cols.has_dur).sum()),
             n_err=int((cols.valid & cols.err).sum()),
@@ -637,18 +640,21 @@ class ShardedAggregator:
             need_rollup = (
                 self._lanes_since_rollup + lanes > self.config.rollup_segment
             )
-            t0 = time.perf_counter() if need_rollup else 0.0
+            t0 = time.perf_counter()
             self.state = self._step_variants[(need_flush, need_rollup)](
                 self.state, device_batch
             )
+            # host wall of the enqueue (async dispatch: this is the cost
+            # ingest actually pays, consistent with ctx_maintenance_ms)
+            step_wall = time.perf_counter() - t0
+            obs.record("device_dispatch", step_wall)
             if need_flush:
                 self._pend_lanes = 0
             if need_rollup:
                 self._lanes_since_rollup = 0
                 self.ctx_stats["ctx_advances"] += 1
-                self.ctx_stats["ctx_maintenance_ms"] = (
-                    time.perf_counter() - t0
-                ) * 1000.0
+                self.ctx_stats["ctx_maintenance_ms"] = step_wall * 1000.0
+                obs.record("rollup", step_wall)
             self._pend_lanes += lanes
             self._lanes_since_rollup += lanes
             self.write_version += 1
@@ -748,7 +754,9 @@ class ShardedAggregator:
         """Device LinkContext for the current state (callers hold lock)."""
         version = self.write_version
         if self._ctx_cache[0] != version:
+            t0 = time.perf_counter()
             self._ctx_cache = (version, self._link_ctx(self.state))
+            obs.record("ctx_advance", time.perf_counter() - t0)
         return self._ctx_cache[1]
 
     def dependency_matrices(

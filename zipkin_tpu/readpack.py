@@ -44,9 +44,12 @@ word-aligned by construction, which is what lets :func:`unpack` return
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Sequence, Tuple
 
 import numpy as np
+
+from zipkin_tpu import obs
 
 MAGIC = 0x5A504B31  # "ZPK1"
 _SECTION_WORDS = 8
@@ -82,7 +85,10 @@ def device_get(x) -> np.ndarray:
         _transfers += 1
     import jax
 
-    return np.asarray(jax.device_get(x))
+    t0 = time.perf_counter()
+    out = np.asarray(jax.device_get(x))
+    obs.record("readpack_transfer", time.perf_counter() - t0)
+    return out
 
 
 def transfer_count() -> int:

@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from zipkin_tpu import obs
 from zipkin_tpu.sampling import RATE_ONE
 
 logger = logging.getLogger(__name__)
@@ -74,6 +75,7 @@ class RateController:
         sampler = self.store.agg.sampler
         if sampler is None or dt_s <= 0:
             return False
+        t0 = time.perf_counter()
         seen, kept = sampler.take_tallies()
         total_seen = int(seen.sum())
         total_kept = int(kept.sum())
@@ -89,13 +91,13 @@ class RateController:
         if total_seen > 0 and budget_spans > 0:
             ratio = min(1.0, budget_spans / total_seen)
             active = seen > 0
-            obs = np.maximum(kept / np.maximum(seen, 1), 1e-6)
+            kept_frac = np.maximum(kept / np.maximum(seen, 1), 1e-6)
             # proportional step toward each service keeping ~ratio of its
             # traffic, slew-limited so one noisy interval can't slam the
-            # rate; error/tail/rare keeps count against obs, so services
-            # whose mandatory keeps already exceed the ratio converge to
-            # the min_rate floor rather than oscillating
-            factor = np.clip(ratio / obs, 0.25, 4.0)
+            # rate; error/tail/rare keeps count against kept_frac, so
+            # services whose mandatory keeps already exceed the ratio
+            # converge to the min_rate floor rather than oscillating
+            factor = np.clip(ratio / kept_frac, 0.25, 4.0)
             rate = np.where(
                 active,
                 np.clip(rate * factor, self.min_rate, RATE_ONE),
@@ -105,6 +107,7 @@ class RateController:
         new_tail = self._tail_thresholds(sampler)
         new_link = sampler.link_snapshot()
         self._publish(sampler, new_rate, new_tail, new_link)
+        obs.record("sampler_tick", time.perf_counter() - t0)
         return True
 
     def _tail_thresholds(self, sampler) -> np.ndarray:

@@ -24,11 +24,13 @@ import asyncio
 import gzip
 import json
 import logging
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 from aiohttp import web
 
 import zipkin_tpu
+from zipkin_tpu import obs
 from zipkin_tpu.collector.core import (
     Collector,
     CollectorSampler,
@@ -170,6 +172,21 @@ class ZipkinServer:
             fast_ingest=self.config.tpu_fast_ingest,
             mp_ingester=self._mp_ingester,
         )
+        self._obs_emitter = None
+        if self.config.obs_selfspans_enabled:
+            from zipkin_tpu.obs.selfspans import SelfSpanEmitter
+
+            # over-budget pipeline stages publish slow-dispatch spans
+            # (service zipkin-tpu-pipeline) through the ordinary object
+            # path — the tracer dogfooding itself
+            self._obs_emitter = SelfSpanEmitter(
+                Collector(
+                    self.storage,
+                    metrics=self.metrics.for_transport("obs"),
+                ),
+                budget_scale=self.config.obs_budget_scale,
+            )
+            self._obs_emitter.install(obs.RECORDER)
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
         self._grpc = None
@@ -212,6 +229,9 @@ class ZipkinServer:
             r.add_get("/api/v2/tpu/counters", self.get_tpu_counters)
             r.add_get("/api/v2/tpu/overview", self.get_tpu_overview)
             r.add_post("/api/v2/tpu/snapshot", self.post_tpu_snapshot)
+        # flight-recorder debug plane: the recorder is process-global,
+        # so this serves regardless of the storage tier
+        r.add_get("/api/v2/tpu/statusz", self.get_tpu_statusz)
         r.add_get("/health", self.get_health)
         r.add_get("/info", self.get_info)
         r.add_get("/metrics", self.get_metrics)
@@ -346,6 +366,14 @@ class ZipkinServer:
                 # and unlinks the shared-memory block
                 await asyncio.to_thread(self._mp_ingester.close)
                 self._mp_ingester = None
+        if self._obs_emitter is not None:
+            # before any final snapshot: the emitter's last flush feeds
+            # spans into storage, and stop() disarms the global recorder
+            # budgets/hook this server installed
+            try:
+                await asyncio.to_thread(self._obs_emitter.stop)
+            finally:
+                self._obs_emitter = None
         if take_final_snapshot:
             # final snapshot LAST: collectors are stopped and the MP
             # queue drained, so every 202-acked span is in storage —
@@ -393,6 +421,7 @@ class ZipkinServer:
         return await self._ingest(request, v1=True)
 
     async def _ingest(self, request: web.Request, *, v1: bool) -> web.Response:
+        t0 = time.perf_counter()
         try:
             body = await self._read_body(request)
         except PayloadTooLarge as e:
@@ -416,6 +445,8 @@ class ZipkinServer:
             # storage throttle shed the write: tell the sender to back off
             # (reference behavior for RejectedExecutionException)
             return web.Response(status=503, text=str(e))
+        # body read → collector hand-off complete; the 202 ack follows
+        obs.record("http_boundary", time.perf_counter() - t0)
         return web.Response(status=202)
 
     # -- query -------------------------------------------------------------
@@ -661,30 +692,103 @@ class ZipkinServer:
             rates = await asyncio.to_thread(self.storage.sampler_rates)
             for svc, rate in sorted(rates.items()):
                 out[f"gauge.zipkin_tpu.samplerRate.{svc}"] = rate
+        # pipeline flight recorder (zipkin_tpu.obs): per-stage quantiles
+        for st in obs.RECORDER.snapshot().nonzero():
+            out[f"gauge.zipkin_tpu.stage.{st.stage}.p50Us"] = st.p50_us
+            out[f"gauge.zipkin_tpu.stage.{st.stage}.p99Us"] = st.p99_us
+            out[f"gauge.zipkin_tpu.stage.{st.stage}.maxUs"] = st.max_us
         return web.json_response(out)
 
     async def get_prometheus(self, request: web.Request) -> web.Response:
         lines: List[str] = []
+        # collector counters, one family per counter name, transport label
+        by_name: Dict[str, List[Tuple[str, float]]] = {}
         for key, value in sorted(self.metrics.snapshot().items()):
             transport, _, name = key.partition(".")
+            by_name.setdefault(name, []).append((transport, value))
+        for name, rows in sorted(by_name.items()):
+            fam = _prom_name(f"zipkin_collector_{name}_total")
             lines.append(
-                f'zipkin_collector_{name}_total{{transport="{transport}"}} {value}'
+                f"# HELP {fam} Collector {name.replace('_', ' ')} by transport."
             )
+            lines.append(f"# TYPE {fam} counter")
+            for transport, value in rows:
+                lines.append(
+                    f'{fam}{{transport="{_prom_label(transport)}"}} {value}'
+                )
         if hasattr(self.storage, "ingest_counters"):
             # device-tier gauges (sketch occupancy / ingest truth counters;
             # with the sampling tier armed this includes sampled_kept /
             # sampled_dropped / budget_utilization)
             counters = await asyncio.to_thread(self.storage.ingest_counters)
             for name, value in sorted(counters.items()):
-                lines.append(f"zipkin_tpu_{_snake(name)} {value}")
+                fam = _prom_name(f"zipkin_tpu_{_snake(name)}")
+                lines.append(f"# HELP {fam} Device-tier gauge {name}.")
+                lines.append(f"# TYPE {fam} gauge")
+                lines.append(f"{fam} {value}")
         if getattr(self.storage, "sampler", None) is not None:
             # live per-service keep probability (1.0 = keep everything)
             rates = await asyncio.to_thread(self.storage.sampler_rates)
-            for svc, rate in sorted(rates.items()):
+            if rates:
                 lines.append(
-                    f'zipkin_tpu_sampler_rate{{service="{svc}"}} {rate}'
+                    "# HELP zipkin_tpu_sampler_rate Live per-service keep "
+                    "probability (1.0 = keep everything)."
                 )
+                lines.append("# TYPE zipkin_tpu_sampler_rate gauge")
+                for svc, rate in sorted(rates.items()):
+                    lines.append(
+                        f'zipkin_tpu_sampler_rate{{service="{_prom_label(svc)}"}} {rate}'
+                    )
+        lines.extend(_prom_stage_histograms(obs.RECORDER.snapshot()))
         return web.Response(text="\n".join(lines) + "\n")
+
+    async def get_tpu_statusz(self, request: web.Request) -> web.Response:
+        """Flight-recorder debug plane: full stage table, the recent
+        over-budget event ring, and the recorder's own measured cost."""
+        rec = obs.RECORDER
+        snap = rec.snapshot()
+        stages = {}
+        for st in snap.stages():
+            budget = rec.budget_us(st.stage)
+            stages[st.stage] = {
+                "count": st.count,
+                "p50Us": st.p50_us,
+                "p99Us": st.p99_us,
+                "maxUs": st.max_us,
+                "sumUs": st.sum_us,
+                "budgetUs": int(budget) if budget != float("inf") else -1,
+            }
+        body = {
+            "stages": stages,
+            "slow": rec.slow_events(),
+            "recorder": {
+                "enabled": rec.enabled,
+                "budgetScale": rec.budget_scale,
+                "writerThreads": snap.locals_seen,
+                "generation": snap.generation,
+                "overheadNsPerRecord": await asyncio.to_thread(
+                    rec.measure_overhead
+                ),
+                "selfSpans": self._obs_emitter is not None,
+                "selfSpansEmitted": (
+                    self._obs_emitter.emitted if self._obs_emitter else 0
+                ),
+            },
+        }
+        if (
+            getattr(self.storage, "sampler", None) is not None
+            and hasattr(self.storage, "ingest_counters")
+        ):
+            counters = await asyncio.to_thread(self.storage.ingest_counters)
+            body["sampler"] = {
+                name: counters[name]
+                for name in (
+                    "budgetUtilization", "samplerPublishes",
+                    "samplerPressure", "sampledKept", "sampledDropped",
+                )
+                if name in counters
+            }
+        return web.json_response(body)
 
     async def get_ui_config(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -708,6 +812,60 @@ def _snake(name: str) -> str:
         else:
             out.append(ch)
     return "".join(out)
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name charset ``[a-zA-Z0-9_:]``,
+    mapping every other rune (dots included) to ``_`` — real scrapers
+    reject the exposition otherwise."""
+    out = "".join(
+        ch if (ch.isascii() and (ch.isalnum() or ch in "_:")) else "_"
+        for ch in name
+    )
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_label(value) -> str:
+    """Escape a label value per the exposition format."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _prom_stage_histograms(snap) -> List[str]:
+    """Flight-recorder stage latencies as one native histogram family.
+
+    Log2-µs buckets become cumulative ``le`` bounds in seconds (the
+    exact inclusive bucket bound, ``(2^b - 1)/1e6``); only non-empty
+    buckets are emitted — cumulative series stay valid when sparse.
+    """
+    stats = snap.nonzero()
+    if not stats:
+        return []
+    fam = "zipkin_tpu_stage_latency_seconds"
+    lines = [
+        f"# HELP {fam} Pipeline stage latency (log2 microsecond buckets).",
+        f"# TYPE {fam} histogram",
+    ]
+    for st in stats:
+        cum = 0
+        for b, count in enumerate(st.buckets[:-1]):
+            if not count:
+                continue
+            cum += count
+            le = obs.bucket_le_us(b) / 1e6
+            lines.append(
+                f'{fam}_bucket{{stage="{st.stage}",le="{le}"}} {cum}'
+            )
+        lines.append(f'{fam}_bucket{{stage="{st.stage}",le="+Inf"}} {st.count}')
+        lines.append(f'{fam}_sum{{stage="{st.stage}"}} {st.sum_us / 1e6}')
+        lines.append(f'{fam}_count{{stage="{st.stage}"}} {st.count}')
+    return lines
 
 
 def parse_annotation_query(raw: Optional[str]) -> Dict[str, str]:

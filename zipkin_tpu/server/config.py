@@ -61,6 +61,13 @@ class ServerConfig:
     throttle_max_concurrency: int = 8
     self_tracing_enabled: bool = False
     self_tracing_sample_rate: float = 1.0
+    # slow-dispatch self-spans (zipkin_tpu.obs): over-budget pipeline
+    # stages are published as internal spans for zipkin-tpu-pipeline
+    # through the collector path. Opt-in like self-tracing — the spans
+    # land in the server's own store. TPU_OBS_BUDGET_SCALE scales every
+    # stage budget (0.0 = everything is "slow"; dogfood/debug posture).
+    obs_selfspans_enabled: bool = False
+    obs_budget_scale: float = 1.0
     # TPU aggregation tier
     tpu_devices: Optional[int] = None  # None = all visible
     tpu_batch_size: int = 8192
@@ -158,6 +165,8 @@ class ServerConfig:
             throttle_max_concurrency=_env_int("STORAGE_THROTTLE_MAX_CONCURRENCY", 8),
             self_tracing_enabled=_env_bool("SELF_TRACING_ENABLED", False),
             self_tracing_sample_rate=_env_float("SELF_TRACING_SAMPLE_RATE", 1.0),
+            obs_selfspans_enabled=_env_bool("TPU_OBS_SELFSPANS", False),
+            obs_budget_scale=_env_float("TPU_OBS_BUDGET_SCALE", 1.0),
             tpu_devices=_env_int("TPU_DEVICES", 0) or None,
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_fast_ingest=fast_ingest,

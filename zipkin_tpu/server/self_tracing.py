@@ -9,7 +9,13 @@ to the same sampling/metrics as any other span).
 
 B3 propagation: incoming ``X-B3-TraceId``/``X-B3-SpanId`` headers join
 the caller's trace the way Brave would; otherwise a fresh trace id is
-minted.
+minted. ``X-B3-Sampled`` is honored per the B3 spec: ``0``/``false``
+suppresses the self-span regardless of the local rate (the caller's
+no-sample decision propagates), ``1``/``true``/``d`` forces it.
+
+While a sampled request is in flight, ``obs.selfspans.CURRENT_B3``
+carries (trace id, self-span id) so over-budget pipeline stages emit
+their slow-dispatch spans parented under this request's trace.
 """
 
 from __future__ import annotations
@@ -23,12 +29,25 @@ from aiohttp import web
 
 from zipkin_tpu.collector.core import Collector, CollectorSampler
 from zipkin_tpu.model.span import Endpoint, Kind, Span
+from zipkin_tpu.obs.selfspans import CURRENT_B3
 
 SERVICE_NAME = "zipkin-server"
 
 
 def _new_id() -> str:
     return f"{random.getrandbits(64) or 1:016x}"
+
+
+def _b3_sampled(header: Optional[str]) -> Optional[bool]:
+    """Decode an ``X-B3-Sampled`` header: None when absent/garbage."""
+    if header is None:
+        return None
+    value = header.strip().lower()
+    if value in ("0", "false"):
+        return False
+    if value in ("1", "true", "d"):  # "d" = debug, implies sampled
+        return True
+    return None
 
 
 def self_tracing_middleware(collector: Collector, sample_rate: float = 1.0):
@@ -41,6 +60,13 @@ def self_tracing_middleware(collector: Collector, sample_rate: float = 1.0):
         parent_id: Optional[str] = request.headers.get("X-B3-SpanId")
         if not trace_id:
             trace_id, parent_id = _new_id(), None
+        forced = _b3_sampled(request.headers.get("X-B3-Sampled"))
+        span_id = _new_id()
+        token = None
+        if forced is not False:
+            # Slow pipeline stages observed under this request B3-link
+            # their self-spans here (contextvars survive to_thread).
+            token = CURRENT_B3.set((trace_id, span_id))
         start = time.time_ns() // 1000
         status = 500
         try:
@@ -51,11 +77,13 @@ def self_tracing_middleware(collector: Collector, sample_rate: float = 1.0):
             status = e.status
             raise
         finally:
+            if token is not None:
+                CURRENT_B3.reset(token)
             duration = max(time.time_ns() // 1000 - start, 1)
             try:
                 span = Span.create(
                     trace_id=trace_id,
-                    id=_new_id(),
+                    id=span_id,
                     parent_id=parent_id,
                     kind=Kind.SERVER,
                     name=f"{request.method.lower()} {request.path}",
@@ -69,7 +97,9 @@ def self_tracing_middleware(collector: Collector, sample_rate: float = 1.0):
                         **({"error": str(status)} if status >= 500 else {}),
                     },
                 )
-                if sampler.test(span):
+                if forced is False:
+                    pass  # caller said no-sample: honor it (B3 spec)
+                elif forced is True or sampler.test(span):
                     # fire-and-forget off the event loop: storing a span
                     # may hit the device and must not stall serving
                     asyncio.get_running_loop().run_in_executor(

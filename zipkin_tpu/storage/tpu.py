@@ -143,7 +143,9 @@ class TpuStorage(_CoreTpuStorage):
             return None
         import json
         import os
+        import time
 
+        from zipkin_tpu import obs
         from zipkin_tpu.tpu.snapshot import META_FILE, save
 
         with self._snapshot_lock:
@@ -153,12 +155,14 @@ class TpuStorage(_CoreTpuStorage):
                 # worker thread kept running); close() holds this lock,
                 # so the flag check is race-free
                 return None
+            t0 = time.perf_counter()
             path = save(self, self.checkpoint_dir)
             wal = getattr(self, "wal", None)
             if wal is not None:
                 with open(os.path.join(path, META_FILE)) as f:
                     covered = json.load(f).get("wal_seq", 0)
                 wal.truncate_covered(covered)
+            obs.record("snapshot", time.perf_counter() - t0)
         return path
 
     def close(self) -> None:

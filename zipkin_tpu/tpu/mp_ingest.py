@@ -36,9 +36,12 @@ import logging
 import multiprocessing as mp
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
+
+from zipkin_tpu import obs
 
 logger = logging.getLogger(__name__)
 
@@ -574,6 +577,7 @@ class MultiProcessIngester:
                     ],
                 )
         if slot is not None:
+            t0 = time.perf_counter()
             size = int(np.prod(shape))
             src = np.frombuffer(
                 self._shm.buf, np.uint32, count=size,
@@ -612,6 +616,7 @@ class MultiProcessIngester:
                 fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
                 ts_range=ts_range,
             )
+            obs.record("mp_record", time.perf_counter() - t0)
             self.counters["accepted"] += n_spans
         self.counters["sampleDropped"] += max(dropped, 0)
         if self.metrics is not None:

@@ -30,6 +30,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zipkin_tpu import obs
 from zipkin_tpu.internal.hex import epoch_minutes
 from zipkin_tpu.ops import hll
 from zipkin_tpu.model.span import DependencyLink, Span
@@ -407,16 +408,20 @@ class TpuStorage(
             # the FULL batch so sketches see 100%.
             for lo in range(0, len(spans), self.max_batch):
                 chunk = spans[lo : lo + self.max_batch]
+                t0 = time.perf_counter()
                 with self._intern_lock:
                     cols = pack_spans(chunk, self.vocab, self._pad)
+                obs.record("pack", time.perf_counter() - t0)
                 kept = chunk
                 if self.agg.sampler is not None:
                     keep = self.agg.sampler.verdict_cols(cols)[: len(chunk)]
                     kept = [s for s, k in zip(chunk, keep) if k]
                 if kept:
+                    t0 = time.perf_counter()
                     self._archive.accept(kept).execute()
                     if self._disk is not None:
                         self._disk_append_spans(kept)
+                    obs.record("archive_write", time.perf_counter() - t0)
                 self.agg.ingest(cols)
 
         return Call.of(run)
@@ -511,11 +516,13 @@ class TpuStorage(
         with self._intern_lock:
             if self._nvocab is None:
                 self._nvocab = native.NativeVocab(self.vocab)
+            t0 = time.perf_counter()
             self._nvocab.ensure_synced()
             parsed = native.parse_spans(data, nvocab=self._nvocab)
             if parsed is None:
                 return None
             self._nvocab.sync()
+            obs.record("parse", time.perf_counter() - t0)
             n = parsed.n
             dropped = 0
             if sampler is not None and sampler.rate < 1.0 and n:
@@ -531,6 +538,7 @@ class TpuStorage(
             if n == 0:
                 return 0, dropped, []
             chunks = []
+            t0 = time.perf_counter()
             for lo_i in range(0, n, self.max_batch):
                 hi_i = min(lo_i + self.max_batch, n)
                 if lo_i == 0 and hi_i == n:
@@ -543,6 +551,7 @@ class TpuStorage(
                         setattr(sub, f, None if col is None else col[lo_i:hi_i])
                     sub.n = hi_i - lo_i
                 chunks.append((sub, pack_parsed(sub, self.vocab, self._pad)))
+            obs.record("pack", time.perf_counter() - t0)
         return n, dropped, chunks
 
     def _fast_dispatch(self, parsed, cols) -> None:
@@ -556,6 +565,7 @@ class TpuStorage(
         if self.agg.sampler is not None:
             keep = self.agg.sampler.verdict_cols(cols)[: parsed.n]
         retained = self._sampled_parsed(parsed, keep)
+        t0 = time.perf_counter()
         if self._disk is not None:
             self._disk_append_parsed(retained)
             if self.autocomplete_keys:
@@ -566,6 +576,7 @@ class TpuStorage(
                 self._archive_fast_sample(retained, retained.n)
         else:
             self._archive_fast_sample(retained, retained.n)
+        obs.record("archive_write", time.perf_counter() - t0)
         self.agg.ingest(cols)
 
     def _sampled_parsed(self, parsed, keep):
@@ -934,6 +945,7 @@ class TpuStorage(
         cache drops when the version advances — keys embed window
         minutes and quantile lists, so per-key staleness checks alone
         would let dead entries accumulate forever under a polling UI."""
+        t0 = time.perf_counter()
         version = self.agg.write_version
         with self._read_cache_lock:
             if self._read_cache_version != version:
@@ -941,8 +953,10 @@ class TpuStorage(
                 self._read_cache_version = version
             hit = self._read_cache.get(key)
             if hit is not None:
+                obs.record("query_cached", time.perf_counter() - t0)
                 return hit
         value = compute()
+        obs.record("query_fresh", time.perf_counter() - t0)
         with self._read_cache_lock:
             if self._read_cache_version == version:
                 self._read_cache[key] = value
@@ -962,6 +976,7 @@ class TpuStorage(
             hi_min = epoch_minutes(end_ts)
             fresh = self.agg.write_version
             now = time.monotonic()
+            t0 = time.perf_counter()
             with self._read_cache_lock:
                 hit = self._deps_cache.get((lo_min, hi_min))
                 if hit is not None:
@@ -969,6 +984,7 @@ class TpuStorage(
                     if version == fresh or (
                         (now - t) * 1000.0 < self._deps_max_stale_ms
                     ):
+                        obs.record("query_cached", time.perf_counter() - t0)
                         return value
             value = self._compute_dependencies(lo_min, hi_min)
             with self._read_cache_lock:

@@ -33,12 +33,13 @@ import json
 import logging
 import os
 import struct
+import time
 import zlib
 from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
-from zipkin_tpu import faults
+from zipkin_tpu import faults, obs
 
 logger = logging.getLogger(__name__)
 
@@ -80,6 +81,7 @@ class WriteAheadLog:
             # segment via _file_for and log a batch after the final
             # snapshot — double-replay on next boot (r3 review finding)
             raise RuntimeError("WAL is closed")
+        t0 = time.perf_counter()
         self._seq += 1
         payload = np.ascontiguousarray(fused, np.uint32).tobytes()
         meta = dict(meta, shape=list(fused.shape))
@@ -103,8 +105,11 @@ class WriteAheadLog:
         fh.flush()
         faults.crashpoint("wal.append.pre_fsync")
         if self.fsync:
+            t1 = time.perf_counter()
             os.fsync(fh.fileno())
+            obs.record("wal_fsync", time.perf_counter() - t1)
         self._fh_bytes += rec_len
+        obs.record("wal_append", time.perf_counter() - t0)
         return self._seq
 
     def _file_for(self, rec_len: int):
