@@ -96,12 +96,19 @@ def main() -> None:
     # ~50% drop rate (ISSUE 4) — the delta vs "json" is the verdict +
     # host-gating overhead (benchmarks/sampling_bench.py decomposes it);
     # "obs": flight-recorder on/off A/B through the server's null-sink
-    # boundary leg (ISSUE 6 — benchmarks/obs_overhead.py owns it).
+    # boundary leg (ISSUE 6 — benchmarks/obs_overhead.py owns it);
+    # "scrub": background at-rest scrubber on/off A/B over a durable
+    # store (ISSUE 7 — benchmarks/scrub_overhead.py owns it).
     mode = os.environ.get("BENCH_MODE", "json")
     if mode == "obs":
         from benchmarks.obs_overhead import main as obs_main
 
         obs_main()
+        return
+    if mode == "scrub":
+        from benchmarks.scrub_overhead import main as scrub_main
+
+        scrub_main()
         return
     # adversarial corpus (VERDICT r2 order 8): unique spans streamed
     # without recycling, service/name cardinality beyond vocab capacity

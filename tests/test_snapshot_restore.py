@@ -129,14 +129,174 @@ def test_intact_snapshot_restores(tmp_path):
 # -- commit protocol -----------------------------------------------------
 
 
+def _states(d):
+    return sorted(
+        n for n in os.listdir(d)
+        if n.startswith("sketch_state-") and n.endswith(".npz")
+    )
+
+
 def test_generations_pruned_and_meta_references_state(tmp_path):
     store, d = _saved(tmp_path)
     snapshot.save(store, d)
     snapshot.save(store, d)
-    gens = [n for n in os.listdir(d) if n.startswith("sketch_state-")]
-    assert len(gens) == 1, gens  # superseded generations pruned
-    assert _meta(d)["state_file"] == gens[0]
+    states = _states(d)
+    # K-generation retention (ISSUE 7): the newest keep_generations stay
+    # as fallback depth; anything older is pruned (state + meta sidecar)
+    assert len(states) == snapshot.DEFAULT_KEEP_GENERATIONS, states
+    assert _meta(d)["state_file"] == states[-1]
+    for name in states:
+        assert os.path.exists(os.path.join(d, snapshot._gen_meta_name(name)))
     assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+    # explicit keep=1 collapses to a single generation, no stray sidecars
+    snapshot.save(store, d, keep=1)
+    assert len(_states(d)) == 1
+    metas = [n for n in os.listdir(d) if n.endswith(".meta.json")]
+    assert metas == [snapshot._gen_meta_name(_states(d)[0])], metas
+
+
+def test_retained_coverage_is_oldest_generation(tmp_path):
+    """WAL truncation floor = MIN wal_seq across retained generations —
+    truncating at the newest would delete the fallback's replay suffix."""
+    store, d = _saved(tmp_path)
+    store.agg.wal_seq = 7
+    snapshot.save(store, d)
+    store.agg.wal_seq = 11
+    snapshot.save(store, d)
+    assert snapshot.retained_coverage(d) == 7
+    # quarantining the older generation lifts the floor to the newest
+    snapshot.quarantine_generation(d, _states(d)[0])
+    assert snapshot.retained_coverage(d) == 11
+
+
+def test_coverage_and_status_before_first_snapshot(tmp_path):
+    """A checkpoint dir that has never committed (or doesn't exist yet)
+    has no coverage and an empty inventory — the statusz durability
+    plane reads these before the first snapshot lands."""
+    missing = str(tmp_path / "never-created")
+    assert snapshot.retained_coverage(missing) is None
+    assert snapshot.generation_status(missing) == []
+
+
+# -- bit-rot fallback (ISSUE 7) ------------------------------------------
+
+
+def _two_generations(tmp_path):
+    """Two retained generations holding DIFFERENT ingest states; returns
+    (dir, counters at gen A, counters at gen B) so fallback tests can
+    pin WHICH generation a restore landed on."""
+    store = _store()
+    store.accept(lots_of_spans(120, seed=7, services=4, span_names=6)).execute()
+    d = str(tmp_path / "snap")
+    snapshot.save(store, d)
+    counters_a = dict(store.agg.host_counters)
+    store.accept(lots_of_spans(80, seed=8, services=4, span_names=6)).execute()
+    snapshot.save(store, d)
+    counters_b = dict(store.agg.host_counters)
+    assert counters_a != counters_b
+    return d, counters_a, counters_b
+
+
+def _tamper_leaf(d, state_name):
+    """Flip one value in one leaf, keeping shapes/dtypes/zip structure
+    valid — the rot only the digest manifest can see."""
+    path = os.path.join(d, state_name)
+    loaded = np.load(path)
+    arrays = {k: loaded[k].copy() for k in loaded.files}
+    flat = arrays["f0"].reshape(-1)
+    orig = flat[:1].copy()
+    flat[0] = flat[0] + 1
+    if flat[:1].tobytes() == orig.tobytes():  # saturating dtype
+        flat[0] = 0 if orig[0] else 1
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+
+
+def test_digest_mismatch_quarantines_and_falls_back(tmp_path, caplog):
+    d, counters_a, _ = _two_generations(tmp_path)
+    newest = _states(d)[-1]
+    _tamper_leaf(d, newest)
+    fresh = _store()
+    with caplog.at_level(logging.WARNING):
+        assert snapshot.maybe_restore(fresh, d)
+    # landed on the OLDER generation, not the rotted newest
+    assert fresh.agg.host_counters == counters_a
+    assert "digest mismatch" in caplog.text
+    assert "fell back" in caplog.text
+    # the bad generation is evidence now: renamed aside, never unlinked
+    assert os.path.exists(os.path.join(d, newest + ".quarantine"))
+    assert not os.path.exists(os.path.join(d, newest))
+    assert fresh.restore_stats["restoreFallbacks"] == 1
+    assert fresh.restore_stats["generationsQuarantined"] == 1
+
+
+def test_missing_newest_state_falls_back_to_older(tmp_path, caplog):
+    """meta.json referencing a missing state file is an integrity
+    failure, not a fatal one, when an older intact generation exists."""
+    d, counters_a, _ = _two_generations(tmp_path)
+    os.unlink(os.path.join(d, _states(d)[-1]))
+    fresh = _store()
+    with caplog.at_level(logging.WARNING):
+        assert snapshot.maybe_restore(fresh, d)
+    assert fresh.agg.host_counters == counters_a
+    assert "missing state file" in caplog.text
+    assert fresh.restore_stats["restoreFallbacks"] == 1
+
+
+def test_unreadable_npz_falls_back(tmp_path):
+    """Gross rot (truncation) surfaces through zipfile's own CRC as an
+    unreadable npz; same fallback as a digest mismatch."""
+    d, counters_a, _ = _two_generations(tmp_path)
+    newest = _states(d)[-1]
+    path = os.path.join(d, newest)
+    os.truncate(path, os.path.getsize(path) // 2)
+    fresh = _store()
+    assert snapshot.maybe_restore(fresh, d)
+    assert fresh.agg.host_counters == counters_a
+    assert os.path.exists(os.path.join(d, newest + ".quarantine"))
+
+
+def test_quarantined_newest_with_intact_older_restores(tmp_path):
+    """A scrubber quarantine between runs: meta.json still names the
+    (now quarantined) newest generation; boot falls back cleanly."""
+    d, counters_a, _ = _two_generations(tmp_path)
+    snapshot.quarantine_generation(d, _states(d)[-1])
+    fresh = _store()
+    assert snapshot.maybe_restore(fresh, d)
+    assert fresh.agg.host_counters == counters_a
+
+
+def test_all_generations_rotted_refuses(tmp_path, caplog):
+    d, _, _ = _two_generations(tmp_path)
+    for name in _states(d):
+        _tamper_leaf(d, name)
+    fresh = _store()
+    _refused(fresh, d, caplog, "digest mismatch")
+    # both rotted generations quarantined, none unlinked
+    assert len([n for n in os.listdir(d) if n.endswith(".npz.quarantine")]) == 2
+
+
+def test_meta_without_manifest_restores_unchecked(tmp_path):
+    """Metas written before the digest manifest carry no leaf_crcs; they
+    keep restoring (unchecked) rather than being treated as rot."""
+    store, d = _saved(tmp_path)
+    meta = _meta(d)
+    del meta["leaf_crcs"]
+    _write_meta(d, meta)
+    fresh = _store()
+    assert snapshot.maybe_restore(fresh, d)
+    assert fresh.agg.host_counters == store.agg.host_counters
+
+
+def test_new_generation_never_reuses_quarantined_name(tmp_path):
+    store, d = _saved(tmp_path)
+    newest = _states(d)[-1]
+    gen = int(newest[len("sketch_state-"):-4])
+    snapshot.quarantine_generation(d, newest)
+    snapshot.save(store, d)
+    # the quarantined name stays unique evidence; the new commit moves on
+    assert int(_states(d)[-1][len("sketch_state-"):-4]) > gen
+    assert os.path.exists(os.path.join(d, newest + ".quarantine"))
 
 
 def test_legacy_snapshot_layout_still_restores(tmp_path):

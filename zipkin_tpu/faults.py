@@ -1,4 +1,4 @@
-"""Crashpoint fault injection for the durability plane (ISSUE 3).
+"""Crashpoint + corruption fault injection for the durability plane.
 
 The durability claims in ARCHITECTURE.md ("every 202-acked batch
 replays after kill -9") are only as good as the crash *timing* they
@@ -16,17 +16,37 @@ timer happens to land on:
 
 Arming is either programmatic (``arm(site, nth=..., action=...)`` from
 an in-process test) or via the environment for subprocess drivers:
-``ZT_CRASHPOINT=<site>[:nth]`` fires on the nth pass through the site
-(default 1st); ``ZT_CRASHPOINT_ACTION`` picks ``kill`` (SIGKILL —
-maximum realism, buffered bytes are lost), ``exit`` (``os._exit`` —
-kills the process but buffered C-level file writes already made are
-kept), or ``raise`` (``CrashpointTriggered`` — in-process simulation;
-the caller must abandon the store object, exactly like the existing
-``del victim`` crash idiom in tests/test_wal.py).
+``ZT_CRASHPOINT=<site>[:nth][,<site>[:nth]...]`` fires each listed
+site on its nth pass (default 1st); ``ZT_CRASHPOINT_ACTION`` picks
+``kill`` (SIGKILL — maximum realism, buffered bytes are lost), ``exit``
+(``os._exit`` — kills the process but buffered C-level file writes
+already made are kept), or ``raise`` (``CrashpointTriggered`` —
+in-process simulation; the caller must abandon the store object,
+exactly like the existing ``del victim`` crash idiom in
+tests/test_wal.py). Multiple sites arm at once so the corruption soak
+can combine a corrupt site with a kill site in one child run.
 
-The disarmed fast path is two comparisons, so production code keeps
-the hooks compiled in; a site is one-shot — it disarms itself as it
-fires so crash *handling* code can re-enter the same path.
+The ``corrupt`` action family (ISSUE 7) models silent media bit-rot
+rather than a crash: a corrupt site names an artifact the write path
+just made durable, and firing it damages those bytes ON DISK — the
+process keeps running, exactly like rot that happens at rest:
+
+- ``snapshot.state``  the newest committed snapshot generation's .npz
+- ``wal.record``      the payload of the WAL record just appended
+- ``archive.frame``   the payload of the archive frame just appended
+
+Damage modes are deterministic (position derived from the artifact's
+byte range, no RNG): ``flip`` XORs one mid-range byte, ``zero`` zeroes
+a mid-range run, ``truncate`` cuts the file mid-artifact. Armed via
+``arm_corrupt(site, mode=..., nth=...)`` or
+``ZT_CORRUPT=<site>[:mode[:nth]]`` (comma-separated like
+ZT_CRASHPOINT). Restore-time digest verification, generation fallback,
+and the background scrubber (runtime/scrub.py) are the recovery story
+these sites exist to prove.
+
+The disarmed fast path is one dict probe, so production code keeps the
+hooks compiled in; a site is one-shot — it disarms itself as it fires
+so crash/scrub *handling* code can re-enter the same path.
 """
 
 from __future__ import annotations
@@ -34,11 +54,11 @@ from __future__ import annotations
 import logging
 import os
 import signal
-from typing import Optional
+from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
-# the site catalog is static so drivers can randomize over it
+# the site catalogs are static so drivers can randomize over them
 SITES = (
     "wal.append.mid",
     "wal.append.pre_fsync",
@@ -46,9 +66,16 @@ SITES = (
     "snapshot.post_meta",
     "archive.mid_segment",
 )
+CORRUPT_SITES = (
+    "snapshot.state",
+    "wal.record",
+    "archive.frame",
+)
+CORRUPT_MODES = ("flip", "truncate", "zero")
 
 ENV_VAR = "ZT_CRASHPOINT"
 ENV_ACTION = "ZT_CRASHPOINT_ACTION"
+ENV_CORRUPT = "ZT_CORRUPT"
 EXIT_CODE = 137  # what a SIGKILL'd child reports; `exit` mimics it
 
 _ACTIONS = ("kill", "exit", "raise")
@@ -60,65 +87,140 @@ class CrashpointTriggered(RuntimeError):
     must be abandoned, not used further."""
 
 
-_site: Optional[str] = None
-_nth = 0
-_action = "kill"
+# site -> [remaining_nth, action]; mutated in place by crashpoint()
+_armed: Dict[str, List] = {}
+# site -> [remaining_nth, mode]; mutated in place by corrupt_point()
+_corrupt_armed: Dict[str, List] = {}
 
 
 def arm(site: str, nth: int = 1, action: str = "kill") -> None:
-    """Arm one site to fire on its ``nth`` traversal."""
+    """Arm one site to fire on its ``nth`` traversal. Arming a second
+    site keeps the first armed (multi-site soaks)."""
     if site not in SITES:
         raise ValueError(f"unknown crashpoint site {site!r} (see faults.SITES)")
     if action not in _ACTIONS:
         raise ValueError(f"unknown crashpoint action {action!r}")
-    global _site, _nth, _action
-    _site, _nth, _action = site, max(1, int(nth)), action
+    _armed[site] = [max(1, int(nth)), action]
+
+
+def arm_corrupt(site: str, mode: str = "flip", nth: int = 1) -> None:
+    """Arm a corruption site to damage its ``nth`` written artifact."""
+    if site not in CORRUPT_SITES:
+        raise ValueError(
+            f"unknown corrupt site {site!r} (see faults.CORRUPT_SITES)"
+        )
+    if mode not in CORRUPT_MODES:
+        raise ValueError(
+            f"unknown corrupt mode {mode!r} (see faults.CORRUPT_MODES)"
+        )
+    _corrupt_armed[site] = [max(1, int(nth)), mode]
 
 
 def disarm() -> None:
-    global _site, _nth
-    _site, _nth = None, 0
+    _armed.clear()
+    _corrupt_armed.clear()
 
 
 def armed_site() -> Optional[str]:
-    return _site
+    """First armed crashpoint site (None when disarmed). With several
+    sites armed, drivers that need the full set should consult their
+    own arming calls; this keeps the single-site API working."""
+    return next(iter(_armed), None)
 
 
 def is_armed(site: str) -> bool:
-    return _site == site
+    return site in _armed
+
+
+def is_corrupt_armed(site: str) -> bool:
+    return site in _corrupt_armed
 
 
 def crashpoint(site: str) -> None:
-    """Hot-path hook. No-op (two comparisons) unless ``site`` is armed."""
-    global _site, _nth
-    if _site is None or site != _site:
+    """Hot-path hook. No-op (one dict probe) unless ``site`` is armed."""
+    spec = _armed.get(site)
+    if spec is None:
         return
-    _nth -= 1
-    if _nth > 0:
+    spec[0] -= 1
+    if spec[0] > 0:
         return
-    _site = None  # one-shot: recovery code may re-enter this same path
-    logger.warning("crashpoint %s firing (action=%s)", site, _action)
-    if _action == "kill":
+    del _armed[site]  # one-shot: recovery code may re-enter this same path
+    action = spec[1]
+    logger.warning("crashpoint %s firing (action=%s)", site, action)
+    if action == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
-    if _action == "exit":
+    if action == "exit":
         os._exit(EXIT_CODE)
     raise CrashpointTriggered(site)
 
 
+def corrupt_point(site: str, path: str, start: int, length: int) -> bool:
+    """Write-path hook: the caller just made ``length`` bytes at
+    ``start`` of ``path`` durable. If ``site`` is armed, damage them in
+    place (deterministically) and return True; the caller continues
+    normally — rot is silent. One-shot like crashpoints."""
+    spec = _corrupt_armed.get(site)
+    if spec is None or length <= 0:
+        return False
+    spec[0] -= 1
+    if spec[0] > 0:
+        return False
+    del _corrupt_armed[site]
+    mode = spec[1]
+    mid = start + length // 2
+    logger.warning(
+        "corrupt point %s firing (mode=%s) on %s [%d:+%d]",
+        site, mode, path, start, length,
+    )
+    if mode == "truncate":
+        os.truncate(path, mid)
+        return True
+    with open(path, "r+b") as fh:
+        if mode == "flip":
+            fh.seek(mid)
+            b = fh.read(1)
+            fh.seek(mid)
+            fh.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+        else:  # zero
+            run = min(256, max(1, length // 3))
+            fh.seek(start + length // 3)
+            fh.write(b"\x00" * run)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return True
+
+
 def _arm_from_env() -> None:
     raw = os.environ.get(ENV_VAR)
-    if not raw:
-        return
-    site, _, nth = raw.partition(":")
-    try:
-        arm(
-            site.strip(),
-            int(nth) if nth.strip() else 1,
-            os.environ.get(ENV_ACTION, "kill").strip() or "kill",
-        )
-    except ValueError as e:
-        # a typo'd env var must not brick a production boot
-        logger.warning("ignoring %s=%r: %s", ENV_VAR, raw, e)
+    if raw:
+        action = os.environ.get(ENV_ACTION, "kill").strip() or "kill"
+        for spec in raw.split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            site, _, nth = spec.partition(":")
+            try:
+                arm(site.strip(), int(nth) if nth.strip() else 1, action)
+            except ValueError as e:
+                # a typo'd env var must not brick a production boot
+                logger.warning("ignoring %s=%r: %s", ENV_VAR, raw, e)
+    raw = os.environ.get(ENV_CORRUPT)
+    if raw:
+        for spec in raw.split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            parts = spec.split(":")
+            try:
+                arm_corrupt(
+                    parts[0].strip(),
+                    parts[1].strip() if len(parts) > 1 and parts[1].strip()
+                    else "flip",
+                    int(parts[2]) if len(parts) > 2 and parts[2].strip()
+                    else 1,
+                )
+            except ValueError as e:
+                logger.warning("ignoring %s=%r: %s", ENV_CORRUPT, raw, e)
 
 
 _arm_from_env()

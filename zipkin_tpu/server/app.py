@@ -94,6 +94,9 @@ def build_storage(config: ServerConfig) -> StorageComponent:
                 sampling_interval_s=config.tpu_sampling_interval_s,
                 sampling_min_rate=config.tpu_sampling_min_rate,
                 sampling_tail_quantile=config.tpu_sampling_tail_quantile,
+                snapshot_keep=config.tpu_snapshot_keep,
+                scrub_interval_s=config.tpu_scrub_interval_s,
+                scrub_bytes_per_sec=config.tpu_scrub_bytes_per_sec,
                 **common,
             )
 
@@ -692,6 +695,17 @@ class ZipkinServer:
             rates = await asyncio.to_thread(self.storage.sampler_rates)
             for svc, rate in sorted(rates.items()):
                 out[f"gauge.zipkin_tpu.samplerRate.{svc}"] = rate
+        # durability-plane gauges (ISSUE 7): at-rest scrub progress and
+        # quarantine tallies (restoreFallbacks / generationsQuarantined
+        # already flow via the restore_stats block above)
+        if counters:
+            for name in (
+                "scrubBytes", "scrubPasses", "scrubCorruptDetected",
+                "segmentsQuarantined", "spansQuarantined",
+                "archiveSegmentsQuarantined", "archiveSpansQuarantined",
+            ):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
         # pipeline flight recorder (zipkin_tpu.obs): per-stage quantiles
         for st in obs.RECORDER.snapshot().nonzero():
             out[f"gauge.zipkin_tpu.stage.{st.stage}.p50Us"] = st.p50_us
@@ -788,7 +802,45 @@ class ZipkinServer:
                 )
                 if name in counters
             }
+        durability = await asyncio.to_thread(self._durability_status)
+        if durability:
+            body["durability"] = durability
         return web.json_response(body)
+
+    def _durability_status(self) -> Optional[dict]:
+        """Durability section of /statusz (ISSUE 7): retained snapshot
+        generations (quarantined ones included — they are the evidence),
+        the WAL coverage window [floor, head], boot-restore fallback
+        tallies, and the scrubber's last-pass summary. Blocking
+        filesystem reads — call via ``asyncio.to_thread``."""
+        ckpt = getattr(self.storage, "checkpoint_dir", None)
+        scrubber = getattr(self.storage, "scrubber", None)
+        wal = getattr(self.storage, "wal", None)
+        if not ckpt and scrubber is None and wal is None:
+            return None
+        out: dict = {}
+        if ckpt:
+            from zipkin_tpu.tpu import snapshot as snap_mod
+
+            out["generations"] = snap_mod.generation_status(ckpt)
+            floor = snap_mod.retained_coverage(ckpt)
+            out["walCoverage"] = {
+                "floor": floor,
+                "head": int(getattr(self.storage.agg, "wal_seq", 0)),
+            }
+        restore = getattr(self.storage, "restore_stats", None)
+        if restore:
+            out["restore"] = {
+                name: restore[name]
+                for name in (
+                    "restoreFallbacks", "generationsQuarantined",
+                    "walReplayBatches", "restoreMs",
+                )
+                if name in restore
+            }
+        if scrubber is not None:
+            out["scrub"] = scrubber.status()
+        return out
 
     async def get_ui_config(self, request: web.Request) -> web.Response:
         return web.json_response(

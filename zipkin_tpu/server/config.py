@@ -96,6 +96,14 @@ class ServerConfig:
     # periodic snapshot cadence (bounds WAL growth + crash-replay
     # window); active only when a checkpoint dir is configured. 0 = off.
     tpu_snapshot_interval_s: float = 300.0
+    # bit-rot tolerance (ISSUE 7): how many intact snapshot generations
+    # a commit retains (the fallback depth — a digest mismatch
+    # quarantines the bad generation and restores the previous one),
+    # and the background at-rest CRC scrubber's cadence + read-bandwidth
+    # pacing. TPU_SCRUB_INTERVAL_S=0 disables scrubbing.
+    tpu_snapshot_keep: int = 2
+    tpu_scrub_interval_s: float = 300.0
+    tpu_scrub_bytes_per_sec: int = 8 << 20
     # adaptive tail-sampling tier (zipkin_tpu.sampling): device-side
     # keep/drop verdicts gate WAL/archive/ring retention while sketches
     # keep seeing 100% of spans. TPU_SAMPLING=true arms the tier;
@@ -186,6 +194,11 @@ class ServerConfig:
                 "TPU_ARCHIVE_SEGMENT_BYTES", 64 << 20
             ),
             tpu_snapshot_interval_s=_env_float("TPU_SNAPSHOT_INTERVAL_S", 300.0),
+            tpu_snapshot_keep=_env_int("TPU_SNAPSHOT_KEEP", 2),
+            tpu_scrub_interval_s=_env_float("TPU_SCRUB_INTERVAL_S", 300.0),
+            tpu_scrub_bytes_per_sec=_env_int(
+                "TPU_SCRUB_BYTES_PER_S", 8 << 20
+            ),
             tpu_sampling=_env_bool("TPU_SAMPLING", False),
             tpu_sampling_budget=_env_float("TPU_SAMPLING_BUDGET", 0.0),
             tpu_sampling_interval_s=_env_float("TPU_SAMPLING_INTERVAL_S", 5.0),

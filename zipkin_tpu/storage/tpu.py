@@ -38,6 +38,9 @@ class TpuStorage(_CoreTpuStorage):
         sampling_min_rate: int = 256,
         sampling_tail_quantile: float = 0.99,
         sampling_rare_min: Optional[int] = None,
+        snapshot_keep: int = 2,
+        scrub_interval_s: float = 0.0,
+        scrub_bytes_per_sec: int = 8 << 20,
     ) -> None:
         mesh = None
         if num_devices is not None:
@@ -67,6 +70,10 @@ class TpuStorage(_CoreTpuStorage):
 
         self.batch_size = batch_size
         self.checkpoint_dir = checkpoint_dir
+        # fallback depth: snapshot commits retain this many intact
+        # generations; the WAL keeps the suffix back to the oldest one
+        # (tpu/snapshot.py, ISSUE 7)
+        self.snapshot_keep = max(1, int(snapshot_keep))
         self._snapshot_lock = threading.Lock()
         # boot restore/replay must not re-gate: WAL batches were compacted
         # to kept lanes at log time and replay restores the exact sampler
@@ -131,22 +138,38 @@ class TpuStorage(_CoreTpuStorage):
         # query is the invariant); boot-time restore/replay pulls are
         # not queries, so the count starts clean here
         self.agg.read_stats["host_transfers"] = 0
+        # background at-rest CRC scrubber (ISSUE 7): re-verifies sealed
+        # WAL segments, archive frames, and retained snapshot
+        # generations on a paced cadence. Off unless an interval is
+        # configured AND something durable exists to scrub.
+        if scrub_interval_s > 0 and (
+            checkpoint_dir or wal_dir or self._disk is not None
+        ):
+            from zipkin_tpu.runtime.scrub import Scrubber
+
+            self.scrubber = Scrubber(
+                self,
+                interval_s=scrub_interval_s,
+                bytes_per_sec=scrub_bytes_per_sec,
+            )
+            self.scrubber.start()
 
     def snapshot(self) -> Optional[str]:
         """Persist device sketch state (see tpu/snapshot.py); returns
-        path. WAL segments fully covered by the snapshot are deleted.
+        path. WAL segments fully covered by the OLDEST retained
+        generation are deleted — truncating at the newest generation's
+        wal_seq would delete exactly the suffix a digest-mismatch
+        fallback needs to replay (ISSUE 7 coverage rule).
         Serialized: a cancelled periodic snapshot's worker thread may
         still be mid-save when a shutdown snapshot starts — unserialized,
         their independent state/meta renames could pair a newer state
         file with an older wal_seq, making the next boot double-replay."""
         if not self.checkpoint_dir:
             return None
-        import json
-        import os
         import time
 
         from zipkin_tpu import obs
-        from zipkin_tpu.tpu.snapshot import META_FILE, save
+        from zipkin_tpu.tpu.snapshot import retained_coverage, save
 
         with self._snapshot_lock:
             if self._closed:
@@ -156,12 +179,12 @@ class TpuStorage(_CoreTpuStorage):
                 # so the flag check is race-free
                 return None
             t0 = time.perf_counter()
-            path = save(self, self.checkpoint_dir)
+            path = save(self, self.checkpoint_dir, keep=self.snapshot_keep)
             wal = getattr(self, "wal", None)
             if wal is not None:
-                with open(os.path.join(path, META_FILE)) as f:
-                    covered = json.load(f).get("wal_seq", 0)
-                wal.truncate_covered(covered)
+                covered = retained_coverage(self.checkpoint_dir)
+                if covered is not None:
+                    wal.truncate_covered(covered)
             obs.record("snapshot", time.perf_counter() - t0)
         return path
 

@@ -59,6 +59,38 @@ _FRAME = struct.Struct("<IIII")  # magic, n_spans, payload_len, payload_crc
 COLS = 11  # u32 lanes per span (see module docstring)
 
 
+def verify_frames(path: str) -> dict:
+    """At-rest integrity scan of one segment's data file (the
+    scrubber's archive leg): walk every frame re-checking magic,
+    structure, and payload crc — the sealed sidecar indexes carry the
+    byte extents but no digest, so this is the only thing that can see
+    rot in the raw span bytes. Returns ``{"ok", "frames", "spans",
+    "bytes", "bad_offset"}``; ``spans`` counts spans in GOOD frames."""
+    out = dict(ok=True, frames=0, spans=0, bytes=0, bad_offset=None)
+    with open(path, "rb") as fh:
+        while True:
+            off = fh.tell()
+            hdr = fh.read(_FRAME.size)
+            if not hdr:
+                break
+            bad = len(hdr) < _FRAME.size
+            if not bad:
+                magic, n, plen, crc = _FRAME.unpack(hdr)
+                bad = magic != _MAGIC
+            if not bad:
+                need = n * COLS * 4 + plen
+                body = fh.read(need)
+                bad = len(body) < need or zlib.crc32(body[n * COLS * 4:]) != crc
+            if bad:
+                out["ok"] = False
+                out["bad_offset"] = off
+                break
+            out["frames"] += 1
+            out["spans"] += n
+            out["bytes"] = fh.tell()
+    return out
+
+
 def _id64(tl0: np.ndarray, tl1: np.ndarray) -> np.ndarray:
     """The span's low-64 trace id as one u64 sort/search key (EXACT, not
     a hash — lenient trace-id matching is exact low-64 equality)."""
@@ -306,6 +338,11 @@ class SpanArchive:
         # segments excluded from a search by their zone-map sidecar
         # (host-side observability; exercised by tests)
         self.segments_skipped = 0
+        # bit-rot accounting (ISSUE 7): sealed segments the scrubber
+        # pulled from service (.quarantine rename) and the spans that
+        # went with them — searches skip them instead of failing
+        self.segments_quarantined = 0
+        self.spans_quarantined = 0
         self._recover()
 
     # -- write side ------------------------------------------------------
@@ -365,6 +402,11 @@ class SpanArchive:
             faults.crashpoint("archive.mid_segment")
             fh.write(payload)
             fh.flush()
+            # bit-rot injection site (ISSUE 7): the frame's payload is
+            # durable — damage it at rest (scrub/recovery must catch it)
+            faults.corrupt_point(
+                "archive.frame", self._live_path, base, len(payload)
+            )
             self._live_bytes = base + len(payload)
             self._live_rows.append(rows)
             self.spans_written += n
@@ -662,12 +704,52 @@ class SpanArchive:
         # breaks, and callers pay a trace fetch per returned candidate
         return sorted(seen.items(), key=lambda kv: -kv[1])[:limit]
 
+    def sealed_segment_paths(self) -> List[str]:
+        """Data-file paths of every sealed segment — the scrub set (the
+        live segment is re-verified by boot recovery, not at rest)."""
+        with self._lock:
+            return [seg.path for seg in self._sealed]
+
+    def quarantine_segment(self, path: str) -> int:
+        """Pull one sealed segment from service: rename its data file +
+        sidecars aside (``.quarantine`` — never unlink, it is postmortem
+        evidence) and drop it from the read set, so searches SKIP the
+        bad frames with accounting instead of failing the query. Returns
+        the span count removed. In-flight queries holding a views()
+        snapshot keep reading through the segment's retained fd — a
+        corrupt payload decodes to a skipped span, never an error."""
+        with self._lock:
+            for i, seg in enumerate(self._sealed):
+                if seg.path == path:
+                    self._sealed.pop(i)
+                    break
+            else:
+                return 0
+            self._path_to_seg.pop(path, None)
+            n = seg.n
+            self.segments_quarantined += 1
+            self.spans_quarantined += n
+            for suffix in ("", ".ids.npy", ".cols.npy", ".meta.npz"):
+                try:
+                    os.replace(
+                        seg.path + suffix, seg.path + suffix + ".quarantine"
+                    )
+                except OSError:
+                    pass
+        logger.warning(
+            "archive segment %s quarantined (%d spans out of service)",
+            path, n,
+        )
+        return n
+
     def counters(self) -> dict:
         with self._lock:
             return {
                 "archiveSpansWritten": self.spans_written,
                 "archiveSpansDroppedRetention": self.spans_dropped_retention,
                 "archiveSearchSegmentsSkipped": self.segments_skipped,
+                "archiveSegmentsQuarantined": self.segments_quarantined,
+                "archiveSpansQuarantined": self.spans_quarantined,
                 "archiveSegments": len(self._sealed)
                 + (1 if self._live_rows else 0),
                 "archiveBytes": sum(s.bytes_used() for s in self._sealed)

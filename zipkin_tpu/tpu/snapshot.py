@@ -15,16 +15,41 @@ atomic commit point:
 1. the state is written to a fresh generation-named file
    (``sketch_state-<gen>.npz``), fsynced, renamed in, dir fsynced —
    the previous generation is untouched;
-2. ``meta.json`` (which names its state file) is written the same way —
+2. a per-generation meta sidecar (``sketch_state-<gen>.meta.json``,
+   same content) is committed the same way — it is what makes the
+   generation independently restorable after meta.json moves on;
+3. ``meta.json`` (which names its state file) is written the same way —
    ``os.replace`` flips the snapshot from old pair to new pair in one
    atomic step;
-3. only then are superseded state generations pruned.
+4. only then are generations older than the newest K pruned.
 
 A crash at any instant (the ``snapshot.post_state`` / ``post_meta``
 crashpoints in zipkin_tpu.faults pin the two worst ones) leaves
 meta.json referencing one COMPLETE state file. fsync before each
 rename is what makes the rename itself crash-durable: a rename of
 unflushed data can survive a power cut while the bytes do not.
+
+Bit-rot tolerance (ISSUE 7): crash consistency says nothing about a
+snapshot that went bad AT REST — a flipped bit in the newest state
+file used to pass shape/dtype validation and silently poison every
+aggregate, unrecoverably (older generations were pruned, covered WAL
+deleted). Three mechanisms close that:
+
+- **Integrity manifest**: the meta records a crc32 per serialized
+  state leaf (``leaf_crcs``); restore recomputes and refuses a
+  mismatching generation instead of loading it.
+- **K-generation retention + lossless fallback**: the newest
+  ``keep_generations`` (default 2) intact generations are retained at
+  commit, and the WAL truncation floor is the OLDEST retained
+  generation's wal_seq (``retained_coverage``). A damaged generation
+  is quarantined (``.quarantine`` rename — never unlinked, it is
+  postmortem evidence) and restore falls back to the previous one,
+  replaying the longer WAL suffix — zero acked-span loss,
+  bit-identical to a boot that never saw the corruption.
+- The ``snapshot.state`` corrupt site (zipkin_tpu.faults) damages the
+  just-committed generation deterministically so the fallback path is
+  soak-tested, and the background scrubber (runtime/scrub.py)
+  re-verifies retained generations at rest.
 
 Replay markers: the snapshot records ingest counters; transports that
 support offsets (replay files, Kafka) can resume from
@@ -39,7 +64,8 @@ import logging
 import os
 import tempfile
 import time
-from typing import TYPE_CHECKING, Optional
+import zlib
+from typing import TYPE_CHECKING, List, Optional
 
 import numpy as np
 
@@ -53,6 +79,10 @@ logger = logging.getLogger(__name__)
 STATE_FILE = "sketch_state.npz"  # legacy single-generation name (read-only)
 META_FILE = "meta.json"
 _STATE_PREFIX = "sketch_state-"
+QUARANTINE_SUFFIX = ".quarantine"
+# how many intact generations a commit retains (the fallback depth);
+# overridable per store via `store.snapshot_keep` / TPU_SNAPSHOT_KEEP
+DEFAULT_KEEP_GENERATIONS = 2
 
 # Bump whenever the AggState pytree or the config serialization changes
 # shape (ADVICE r2: v1 silently covered two incompatible layouts and
@@ -76,9 +106,16 @@ def _fsync_dir(directory: str) -> None:
 
 
 def _state_generations(directory: str):
-    """[(gen, filename)] for every generation-named state file, sorted."""
+    """[(gen, filename)] for every generation-named state file, sorted.
+    Quarantined generations (``.npz.quarantine``) are excluded — they
+    are evidence, not candidates. A directory that does not exist yet
+    (no snapshot ever committed) simply has no generations."""
     out = []
-    for name in os.listdir(directory):
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return out
+    for name in names:
         if name.startswith(_STATE_PREFIX) and name.endswith(".npz"):
             try:
                 out.append((int(name[len(_STATE_PREFIX):-4]), name))
@@ -88,9 +125,74 @@ def _state_generations(directory: str):
     return out
 
 
-def save(store: "TpuStorage", directory: str) -> str:
+def _gen_meta_name(state_name: str) -> str:
+    """sketch_state-<gen>.npz -> sketch_state-<gen>.meta.json"""
+    return state_name[:-4] + ".meta.json"
+
+
+def _next_generation(directory: str) -> int:
+    """One past the highest generation number ever used — quarantined
+    generations count, so a new state file never reuses the name a
+    quarantined ``.npz.quarantine`` sibling was renamed from."""
+    top = 0
+    for name in os.listdir(directory):
+        stem = name
+        if stem.endswith(QUARANTINE_SUFFIX):
+            stem = stem[: -len(QUARANTINE_SUFFIX)]
+        if stem.startswith(_STATE_PREFIX) and stem.endswith(".npz"):
+            try:
+                top = max(top, int(stem[len(_STATE_PREFIX):-4]))
+            except ValueError:
+                continue
+    return top + 1
+
+
+def _write_atomic(directory: str, name: str, text: str) -> None:
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
+    with os.fdopen(fd, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(directory, name))
+    _fsync_dir(directory)
+
+
+def _quarantine(path: str) -> bool:
+    """Rename ``path`` aside with the quarantine suffix — NEVER unlink:
+    a quarantined artifact is the postmortem evidence of what rotted."""
+    try:
+        os.replace(path, path + QUARANTINE_SUFFIX)
+        return True
+    except OSError:
+        return False
+
+
+def quarantine_generation(directory: str, state_name: str) -> None:
+    """Move one generation (state file + its meta sidecar) aside."""
+    quarantined = _quarantine(os.path.join(directory, state_name))
+    _quarantine(os.path.join(directory, _gen_meta_name(state_name)))
+    if quarantined:
+        logger.warning(
+            "snapshot generation %s quarantined (-> %s%s)",
+            state_name, state_name, QUARANTINE_SUFFIX,
+        )
+
+
+def leaf_digests(arrays: List[np.ndarray]) -> List[int]:
+    """crc32 per serialized state leaf — the integrity manifest."""
+    return [
+        zlib.crc32(np.ascontiguousarray(a).tobytes()) for a in arrays
+    ]
+
+
+def save(
+    store: "TpuStorage", directory: str, keep: Optional[int] = None
+) -> str:
     """Snapshot sketches + vocab into ``directory`` (atomic). Returns path."""
     os.makedirs(directory, exist_ok=True)
+    if keep is None:
+        keep = getattr(store, "snapshot_keep", DEFAULT_KEEP_GENERATIONS)
+    keep = max(1, int(keep))
     # consistency: the state is CLONED on device under the aggregator
     # lock together with wal_seq AND the host counters (so "state +
     # counters + everything after wal_seq" describe the same instant),
@@ -108,15 +210,15 @@ def save(store: "TpuStorage", directory: str) -> str:
             except OSError:
                 pass
 
-    gens = _state_generations(directory)
-    gen = (gens[-1][0] + 1) if gens else 1
+    gen = _next_generation(directory)
     state_name = f"{_STATE_PREFIX}{gen:08d}.npz"
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     with os.fdopen(fd, "wb") as f:  # file object: savez won't append ".npz"
         np.savez_compressed(f, **arrays)
         f.flush()
         os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(directory, state_name))
+    state_path = os.path.join(directory, state_name)
+    os.replace(tmp, state_path)
     _fsync_dir(directory)
     faults.crashpoint("snapshot.post_state")
 
@@ -125,6 +227,11 @@ def save(store: "TpuStorage", directory: str) -> str:
         "saved_at": time.time(),
         "wal_seq": wal_seq,
         "state_file": state_name,
+        # integrity manifest: crc32 per serialized leaf, verified on
+        # every restore and by the at-rest scrubber — shape/dtype
+        # validation alone cannot see a flipped bit
+        "digest": "crc32",
+        "leaf_crcs": leaf_digests([arrays[f"f{i}"] for i in range(len(arrays))]),
         "n_shards": store.agg.n_shards,
         "config": dataclasses.asdict(store.config),
         # agg counters from the locked capture; vocab-overflow counters
@@ -135,22 +242,27 @@ def save(store: "TpuStorage", directory: str) -> str:
         "span_names": store.vocab.span_names._names,
         "keys": store.vocab._key_list,
     }
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".json.tmp")
-    with os.fdopen(fd, "w") as f:
-        json.dump(meta, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(directory, META_FILE))
-    _fsync_dir(directory)
+    meta_text = json.dumps(meta)
+    # the per-generation sidecar first: once meta.json moves on to a
+    # newer generation, this sidecar is the ONLY record of this
+    # generation's wal_seq/digests — what makes fallback restorable
+    _write_atomic(directory, _gen_meta_name(state_name), meta_text)
+    _write_atomic(directory, META_FILE, meta_text)
     faults.crashpoint("snapshot.post_meta")
+    # bit-rot injection site: the generation just committed is damaged
+    # AT REST (process keeps running) — restore/scrub must catch it
+    faults.corrupt_point(
+        "snapshot.state", state_path, 0, os.path.getsize(state_path)
+    )
 
-    # the new pair is durable — superseded generations (and the legacy
-    # un-generationed file, if this dir predates the commit protocol)
-    # can go
-    for old_gen, name in gens:
-        if old_gen != gen:
+    # the new pair is durable — generations older than the newest
+    # ``keep`` (and the legacy un-generationed file, if this dir
+    # predates the commit protocol) can go. Quarantined generations are
+    # never touched: evidence, not garbage.
+    for old_gen, name in _state_generations(directory)[:-keep]:
+        for victim in (name, _gen_meta_name(name)):
             try:
-                os.unlink(os.path.join(directory, name))
+                os.unlink(os.path.join(directory, victim))
             except OSError:
                 pass
     try:
@@ -161,45 +273,127 @@ def save(store: "TpuStorage", directory: str) -> str:
 
 
 def maybe_restore(store: "TpuStorage", directory: str) -> bool:
-    """Restore state + vocab if a compatible snapshot exists."""
+    """Restore state + vocab if a compatible snapshot exists.
+
+    Fallback (ISSUE 7): candidates are tried newest-first — meta.json's
+    generation, then every older retained generation through its
+    per-generation meta sidecar. An integrity failure (missing state
+    file, unreadable npz, leaf digest mismatch) quarantines that
+    generation and falls back to the next; WAL replay from the older
+    wal_seq then recovers the difference losslessly (truncate_covered
+    keeps the WAL suffix back to the oldest retained generation). A
+    COMPATIBILITY failure (version/config/shard/layout drift) stops the
+    whole restore instead — older generations are necessarily at least
+    as incompatible, and an intact-but-foreign snapshot is operator
+    error, not rot."""
     meta_path = os.path.join(directory, META_FILE)
     if not os.path.exists(meta_path):
         return False
-    with open(meta_path) as f:
-        meta = json.load(f)
-    # legacy snapshots (pre-commit-protocol) have no state_file key
-    state_path = os.path.join(directory, meta.get("state_file", STATE_FILE))
+    candidates = []  # (meta dict, state_name) newest first
+    primary_name = None
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        primary_name = meta.get("state_file", STATE_FILE)
+        candidates.append((meta, primary_name))
+    except (OSError, ValueError):
+        logger.warning(
+            "snapshot at %s: meta.json unreadable; trying retained "
+            "generations", directory,
+        )
+    primary_gen = None
+    if primary_name and primary_name.startswith(_STATE_PREFIX):
+        try:
+            primary_gen = int(primary_name[len(_STATE_PREFIX):-4])
+        except ValueError:
+            pass
+    for gen, name in reversed(_state_generations(directory)):
+        if name == primary_name:
+            continue
+        if primary_gen is not None and gen > primary_gen:
+            # newer than the commit point: the generation landed but its
+            # meta.json flip did not — never restore past the commit
+            continue
+        gm = os.path.join(directory, _gen_meta_name(name))
+        try:
+            with open(gm) as f:
+                candidates.append((json.load(f), name))
+        except (OSError, ValueError):
+            continue  # orphan (crash between state and sidecar commit)
+
+    for i, (cand, state_name) in enumerate(candidates):
+        outcome = _restore_one(store, directory, cand, state_name)
+        if outcome == "ok":
+            if i:
+                stats = getattr(store, "restore_stats", None)
+                if stats is not None:
+                    stats["restoreFallbacks"] = (
+                        stats.get("restoreFallbacks", 0) + 1
+                    )
+                logger.warning(
+                    "snapshot restore fell back %d generation(s) to %s; "
+                    "the WAL suffix past its wal_seq replays the rest",
+                    i, state_name,
+                )
+            return True
+        if outcome == "incompatible":
+            return False
+        # integrity failure: quarantine and fall back to the next
+        quarantine_generation(directory, state_name)
+        stats = getattr(store, "restore_stats", None)
+        if stats is not None:
+            stats["generationsQuarantined"] = (
+                stats.get("generationsQuarantined", 0) + 1
+            )
+    return False
+
+
+def _restore_one(
+    store: "TpuStorage", directory: str, meta: dict, state_name: str
+) -> str:
+    """Try one generation; returns "ok", "incompatible", or "integrity"."""
+    state_path = os.path.join(directory, state_name)
     if not os.path.exists(state_path):
         logger.warning(
             "snapshot at %s: meta references missing state file %s; "
             "ignoring", directory, os.path.basename(state_path),
         )
-        return False
+        return "integrity"
     if meta.get("version") != SNAPSHOT_VERSION:
         logger.warning(
             "snapshot at %s has format version %s (this build writes %s); "
             "ignoring — re-snapshot after the next ingest",
             directory, meta.get("version"), SNAPSHOT_VERSION,
         )
-        return False
+        return "incompatible"
     want = dataclasses.asdict(store.config)
     if meta.get("config") != want:
         logger.warning(
             "snapshot at %s was taken under a different AggConfig "
             "(operator config changed); ignoring", directory,
         )
-        return False
+        return "incompatible"
     if meta.get("n_shards") != store.agg.n_shards:
         logger.warning(
             "snapshot at %s has %s shards but this mesh has %s; ignoring",
             directory, meta.get("n_shards"), store.agg.n_shards,
         )
-        return False
+        return "incompatible"
 
     import jax
 
-    loaded = np.load(state_path)
-    leaves = [loaded[f"f{i}"] for i in range(len(loaded.files))]
+    try:
+        # np.load of an npz reads through zipfile, which CRC-checks each
+        # member — gross rot (truncation, zeroed ranges) surfaces here
+        # as an exception rather than as garbage leaves
+        loaded = np.load(state_path)
+        leaves = [loaded[f"f{i}"] for i in range(len(loaded.files))]
+    except Exception as e:
+        logger.warning(
+            "snapshot at %s: state file %s unreadable (%s); quarantining",
+            directory, state_name, e,
+        )
+        return "integrity"
     template = store.agg.state
     if len(leaves) != len(template):
         logger.warning(
@@ -207,7 +401,7 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
             "%d (leaf count mismatch); ignoring",
             directory, len(leaves), len(template),
         )
-        return False
+        return "incompatible"
     # layout drift fails HERE with names, not later as an opaque device
     # error mid-device_put (same version+config can still disagree when
     # a leaf's derived sizing rule changed between builds)
@@ -222,7 +416,28 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
                 tuple(leaf.shape), leaf.dtype,
                 tuple(tmpl.shape), tmpl.dtype,
             )
-            return False
+            return "incompatible"
+    # integrity manifest: recompute each leaf's digest against the
+    # meta's record. Legacy metas (no manifest) restore unchecked —
+    # the un-generationed layout predates the digests.
+    crcs = meta.get("leaf_crcs")
+    if crcs is not None:
+        if len(crcs) != len(leaves):
+            logger.warning(
+                "snapshot at %s: digest manifest has %d entries for %d "
+                "leaves; quarantining", directory, len(crcs), len(leaves),
+            )
+            return "integrity"
+        got = leaf_digests(leaves)
+        for i, (want_crc, got_crc) in enumerate(zip(crcs, got)):
+            if int(want_crc) != got_crc:
+                logger.warning(
+                    "snapshot at %s: leaf %s digest mismatch (crc32 "
+                    "%08x != manifest %08x) — bit rot in %s; quarantining",
+                    directory, fields[i] if fields else f"f{i}",
+                    got_crc, int(want_crc), state_name,
+                )
+                return "integrity"
     with store.agg.lock:
         store.agg.state = jax.device_put(
             type(template)(*leaves), store.agg._sharding
@@ -250,4 +465,71 @@ def maybe_restore(store: "TpuStorage", directory: str) -> bool:
     if on_leaves is not None:
         on_leaves(dict(zip(fields or (), leaves)))
     logger.info("restored TPU sketch snapshot from %s", directory)
-    return True
+    return "ok"
+
+
+def retained_coverage(directory: str) -> Optional[int]:
+    """The wal_seq floor the WAL must keep replayable: the MINIMUM
+    wal_seq across every retained (non-quarantined) generation. With
+    K-generation retention, truncating at the newest generation's
+    wal_seq would delete exactly the suffix a fallback restore needs —
+    the oldest retained generation is the coverage rule (ISSUE 7).
+    None when nothing restorable exists."""
+    seqs = []
+    meta_path = os.path.join(directory, META_FILE)
+    try:
+        with open(meta_path) as f:
+            seqs.append(int(json.load(f).get("wal_seq", 0)))
+    except (OSError, ValueError):
+        pass
+    for _, name in _state_generations(directory):
+        try:
+            with open(os.path.join(directory, _gen_meta_name(name))) as f:
+                seqs.append(int(json.load(f).get("wal_seq", 0)))
+        except (OSError, ValueError):
+            continue
+    return min(seqs) if seqs else None
+
+
+def generation_status(directory: str) -> List[dict]:
+    """Durability inventory for the statusz debug plane: every
+    generation on disk (quarantined included), newest first."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        stem, quarantined = name, False
+        if stem.endswith(QUARANTINE_SUFFIX):
+            stem, quarantined = stem[: -len(QUARANTINE_SUFFIX)], True
+        if not (stem.startswith(_STATE_PREFIX) and stem.endswith(".npz")):
+            continue
+        try:
+            gen = int(stem[len(_STATE_PREFIX):-4])
+        except ValueError:
+            continue
+        entry = {
+            "generation": gen,
+            "stateFile": name,
+            "quarantined": quarantined,
+            "walSeq": None,
+            "bytes": 0,
+        }
+        try:
+            entry["bytes"] = os.path.getsize(os.path.join(directory, name))
+        except OSError:
+            pass
+        for gm in (
+            _gen_meta_name(stem),
+            _gen_meta_name(stem) + QUARANTINE_SUFFIX,
+        ):
+            try:
+                with open(os.path.join(directory, gm)) as f:
+                    entry["walSeq"] = int(json.load(f).get("wal_seq", 0))
+                break
+            except (OSError, ValueError):
+                continue
+        out.append(entry)
+    out.sort(key=lambda e: -e["generation"])
+    return out

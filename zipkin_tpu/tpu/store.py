@@ -174,7 +174,16 @@ class TpuStorage(
             "restoreMs": 0.0,
             "walReplayBatches": 0,
             "walReplayMs": 0.0,
+            # bit-rot accounting (ISSUE 7): how many snapshot
+            # generations the last boot quarantined, and whether it had
+            # to fall back past the newest one (tpu/snapshot.py)
+            "restoreFallbacks": 0,
+            "generationsQuarantined": 0,
         }
+        # background at-rest CRC scrubber (runtime/scrub.py); installed
+        # by the resume-capable adapter when scrubbing is enabled, its
+        # counters merge into ingest_counters below
+        self.scrubber = None
         # disk-backed raw-span archive (VERDICT r3 order 2): when set,
         # EVERY ingested span's raw JSON is retained on disk behind a
         # trace-id index (retention = a disk-byte budget), so fast-mode
@@ -275,6 +284,33 @@ class TpuStorage(
             logger.warning("archive vocab sidecar unreadable; search over "
                            "recovered segments will miss pre-restart spans")
             return
+        # digest coverage (ISSUE 7): the sidecar self-records a crc32 of
+        # its canonical payload; rot here would silently remap every id
+        # on recovered segments. A bad sidecar is quarantined (renamed,
+        # never unlinked) and the boot degrades exactly like a missing
+        # one. Pre-digest sidecars (no crc32 key) load unchecked.
+        want_crc = meta.pop("crc32", None)
+        if want_crc is not None:
+            import zlib as _zlib
+
+            got = _zlib.crc32(
+                json.dumps(meta, sort_keys=True, separators=(",", ":")).encode()
+            )
+            if got != int(want_crc):
+                logger.warning(
+                    "archive vocab sidecar digest mismatch (crc32 %08x != "
+                    "recorded %08x) — bit rot; quarantining. Search over "
+                    "recovered segments will miss pre-restart spans",
+                    got, int(want_crc),
+                )
+                try:
+                    _os.replace(
+                        self._archive_vocab_path,
+                        self._archive_vocab_path + ".quarantine",
+                    )
+                except OSError:
+                    pass
+                return
         v = self.vocab
         v.services._names = list(meta["services"])
         v.services._ids = {
@@ -342,6 +378,13 @@ class TpuStorage(
                         },
                     }
                 self._archive_vocab_persisted = size
+            import zlib as _zlib
+
+            # self-digest over the canonical payload (see
+            # _load_archive_vocab's verification)
+            meta["crc32"] = _zlib.crc32(
+                json.dumps(meta, sort_keys=True, separators=(",", ":")).encode()
+            )
             d = _os.path.dirname(self._archive_vocab_path)
             fd, tmp = _tempfile.mkstemp(dir=d, suffix=".json.tmp")
             with _os.fdopen(fd, "w") as f:
@@ -1214,6 +1257,10 @@ class TpuStorage(
             # walReplayMs): how much recovery cost the last boot
             **self.restore_stats,
             **(self._disk.counters() if self._disk is not None else {}),
+            # at-rest integrity gauges (scrubBytes / segmentsQuarantined
+            # / spansQuarantined / ...): what the background scrubber
+            # verified and what it had to pull from service
+            **(self.scrubber.counters() if self.scrubber is not None else {}),
             # sampling-tier gauges (samplerPublishes / samplerPressure /
             # budgetUtilization / samplerRate*) — sampledKept/Dropped
             # come exact from agg.host_counters above
@@ -1253,6 +1300,8 @@ class TpuStorage(
 
     def close(self) -> None:
         self._closed = True
+        if self.scrubber is not None:
+            self.scrubber.stop()
         if self.sampling_controller is not None:
             self.sampling_controller.stop()
         if self._disk is not None:

@@ -59,13 +59,17 @@ class WriteAheadLog:
         self.fsync = fsync
         os.makedirs(directory, exist_ok=True)
         self._fh = None
+        self._path: Optional[str] = None
         self._fh_bytes = 0
         self._seg_idx = 0
         self._seq = 0
         self._closed = False
-        # resume numbering after the existing records
-        for seq, _, _ in self.records():
-            self._seq = max(self._seq, seq)
+        # resume numbering after the existing records — via a HEADER
+        # walk, not records(): records() stops at the first bad payload
+        # crc, so mid-segment rot would hide the seq high-water mark and
+        # a revived writer would re-issue seqs a snapshot already covers
+        # (replay silently skips covered seqs: acked-span loss)
+        self._seq = self._scan_high_seq()
         segs = self._segments()
         if segs:
             self._seg_idx = segs[-1][0] + 1
@@ -108,6 +112,12 @@ class WriteAheadLog:
             t1 = time.perf_counter()
             os.fsync(fh.fileno())
             obs.record("wal_fsync", time.perf_counter() - t1)
+        # bit-rot injection site (ISSUE 7): the record's payload bytes
+        # are durable — damage them at rest; the process keeps running
+        faults.corrupt_point(
+            "wal.record", self._path,
+            self._fh_bytes + _HEADER.size + len(meta_b), len(payload),
+        )
         self._fh_bytes += rec_len
         obs.record("wal_append", time.perf_counter() - t0)
         return self._seq
@@ -124,8 +134,34 @@ class WriteAheadLog:
             )
             self._seg_idx += 1
             self._fh = open(path, "ab")
+            self._path = path
             self._fh_bytes = os.path.getsize(path)
         return self._fh
+
+    def _scan_high_seq(self) -> int:
+        """Max seq over every structurally valid record HEADER across
+        all segments. Payload damage (flipped/zeroed bytes) leaves the
+        headers after it reachable, so rot cannot roll numbering back;
+        a rotted header still ends the walk early — attach() closes that
+        residual gap by flooring the counter at the snapshot's seq."""
+        top = 0
+        for _, path in self._segments():
+            try:
+                with open(path, "rb") as fh:
+                    while True:
+                        head = fh.read(_HEADER.size)
+                        if len(head) < _HEADER.size:
+                            break
+                        magic, seq, meta_len, payload_len, _ = _HEADER.unpack(
+                            head
+                        )
+                        if magic != _MAGIC:
+                            break
+                        top = max(top, seq)
+                        fh.seek(meta_len + payload_len, os.SEEK_CUR)
+            except OSError:
+                continue
+        return top
 
     # -- read side -------------------------------------------------------
 
@@ -155,12 +191,14 @@ class WriteAheadLog:
         for _, path in self._segments():
             with open(path, "rb") as fh:
                 while True:
+                    rec_off = fh.tell()
                     head = fh.read(_HEADER.size)
                     if not head:
                         break
                     if len(head) < _HEADER.size:
                         logger.warning(
-                            "WAL %s: torn header; skipping segment tail", path
+                            "WAL %s: torn header at offset %d; skipping "
+                            "segment tail", path, rec_off,
                         )
                         break
                     magic, seq, meta_len, payload_len, crc = _HEADER.unpack(
@@ -168,7 +206,8 @@ class WriteAheadLog:
                     )
                     if magic != _MAGIC:
                         logger.warning(
-                            "WAL %s: bad magic; skipping segment tail", path
+                            "WAL %s: bad magic at offset %d; skipping "
+                            "segment tail", path, rec_off,
                         )
                         break
                     if seq <= from_seq:
@@ -185,12 +224,17 @@ class WriteAheadLog:
                     payload = fh.read(payload_len)
                     if len(meta_b) < meta_len or len(payload) < payload_len:
                         logger.warning(
-                            "WAL %s: torn record; skipping segment tail", path
+                            "WAL %s: torn record seq %d at offset %d; "
+                            "skipping segment tail", path, seq, rec_off,
                         )
                         break
                     if zlib.crc32(payload) != crc:
+                        # seq + offset so a postmortem can tell exactly
+                        # where the abandonment started and how much of
+                        # the segment it cost (ISSUE 7 satellite)
                         logger.warning(
-                            "WAL %s: bad crc; skipping segment tail", path
+                            "WAL %s: bad crc on record seq %d at offset %d; "
+                            "skipping segment tail", path, seq, rec_off,
                         )
                         break
                     meta = json.loads(meta_b)
@@ -239,11 +283,64 @@ class WriteAheadLog:
                 os.unlink(path)
                 logger.info("WAL segment %s truncated (<= %d)", path, covered_seq)
 
+    def sealed_segment_paths(self):
+        """Segment paths EXCLUDING the newest — the scrub set. The
+        newest segment is the live writer target and the seq high-water
+        carrier; it is never scrubbed-quarantined (runtime/scrub.py)."""
+        return [path for _, path in self._segments()[:-1]]
+
     def close(self) -> None:
         self._closed = True
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+def verify_segment(path: str) -> dict:
+    """At-rest integrity scan of one segment (the scrubber's WAL leg):
+    re-read every record, checking structure, payload crc, AND meta
+    JSON validity (the header crc covers only the payload — rotted meta
+    would otherwise surface as a json error mid-replay). Returns
+    ``{"ok", "records", "max_seq", "bytes", "bad_seq", "bad_offset"}``;
+    on damage, ``bad_seq``/``bad_offset`` locate the first bad record
+    and ``max_seq`` covers only the records BEFORE it."""
+    out = dict(
+        ok=True, records=0, max_seq=0, bytes=0, bad_seq=None,
+        bad_offset=None,
+    )
+    with open(path, "rb") as fh:
+        while True:
+            rec_off = fh.tell()
+            head = fh.read(_HEADER.size)
+            if not head:
+                break
+            bad = len(head) < _HEADER.size
+            seq = None
+            if not bad:
+                magic, seq, meta_len, payload_len, crc = _HEADER.unpack(head)
+                bad = magic != _MAGIC
+            if not bad:
+                meta_b = fh.read(meta_len)
+                payload = fh.read(payload_len)
+                bad = (
+                    len(meta_b) < meta_len
+                    or len(payload) < payload_len
+                    or zlib.crc32(payload) != crc
+                )
+                if not bad:
+                    try:
+                        json.loads(meta_b)
+                    except ValueError:
+                        bad = True
+            if bad:
+                out["ok"] = False
+                out["bad_seq"] = seq
+                out["bad_offset"] = rec_off
+                break
+            out["records"] += 1
+            out["max_seq"] = max(out["max_seq"], seq)
+            out["bytes"] = fh.tell()
+    return out
 
 
 def attach(store, wal: WriteAheadLog) -> WriteAheadLog:
@@ -252,6 +349,11 @@ def attach(store, wal: WriteAheadLog) -> WriteAheadLog:
     records the applied sequence for snapshot coordination. Call AFTER
     any replay so the vocab delta cursors start at the current state."""
     vocab = store.vocab
+    # numbering floor: never hand a new append a seq the restored
+    # snapshot already covers (rotted headers can hide the true
+    # high-water mark from the boot scan; covered seqs are skipped at
+    # replay, so a re-issued one would lose an acked batch)
+    wal._seq = max(wal._seq, int(getattr(store.agg, "wal_seq", 0)))
     sent = {"svc": 1, "name": 1, "pair": 1}
     # fast-forward the delta cursors past what a restored snapshot (or
     # prior replay) already covers — those entries are in snapshot meta
