@@ -98,7 +98,10 @@ def main() -> None:
     # "obs": flight-recorder on/off A/B through the server's null-sink
     # boundary leg (ISSUE 6 — benchmarks/obs_overhead.py owns it);
     # "scrub": background at-rest scrubber on/off A/B over a durable
-    # store (ISSUE 7 — benchmarks/scrub_overhead.py owns it).
+    # store (ISSUE 7 — benchmarks/scrub_overhead.py owns it);
+    # "fanout": wire-to-ack matrix over the parse fan-out tier —
+    # workers x format x transport with per-stage decomposition and the
+    # 429 onset probe (benchmarks/ingest_fanout.py owns it, INGEST_r07).
     mode = os.environ.get("BENCH_MODE", "json")
     if mode == "obs":
         from benchmarks.obs_overhead import main as obs_main
@@ -109,6 +112,11 @@ def main() -> None:
         from benchmarks.scrub_overhead import main as scrub_main
 
         scrub_main()
+        return
+    if mode == "fanout":
+        from benchmarks.ingest_fanout import main as fanout_main
+
+        fanout_main()
         return
     # adversarial corpus (VERDICT r2 order 8): unique spans streamed
     # without recycling, service/name cardinality beyond vocab capacity

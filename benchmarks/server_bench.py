@@ -27,8 +27,13 @@ into its layers —
 - ``full``  — the real store: parse + raw-span archive + device feed
 
 and prints per-span µs for boundary / parse / feed as a table plus one
-JSON line. MP workers are forced off here: the decomposition targets
-the in-process path (workers would move parse off the timed core).
+JSON line. The boundary/parse/feed triple runs in-process (workers=0)
+so the subtraction stays meaningful; a fourth pass then re-runs the
+``full`` leg at each point of the workers axis (SERVER_BENCH_WORKERS_AXIS,
+default ``1,2,4`` — the fan-out tier of tpu/mp_ingest.py) so the same
+table shows the fan-out scaling curve next to the serial decomposition.
+On a one-core host the axis documents the measured DEGRADATION (workers
+time-slice the core); the scaling story needs a multi-core host.
 DECOMPOSE is the offline A/B splitter; since the obs tier landed it is
 no longer the only stage-timing source — the in-process flight
 recorder (zipkin_tpu/obs, surfaced at /api/v2/tpu/statusz) times the
@@ -48,19 +53,39 @@ import time
 
 
 async def _drive(server, port: int, fmt: str, payloads, batch: int,
-                 total: int) -> float:
+                 total: int, stats=None) -> float:
     """Post ``total`` spans (two requests in flight) and return elapsed
-    seconds. Every response must be the enqueue ack (202 / empty)."""
+    seconds. Every response must be the enqueue ack (202 / empty) or the
+    fan-out tier's backpressure signal (HTTP 429 / RESOURCE_EXHAUSTED),
+    which is retried after a short backoff — that IS sustained wire-to-
+    ack throughput under a bounded tier. ``stats['backpressure']``
+    counts the pushbacks when a dict is passed."""
     from aiohttp import ClientSession, TCPConnector
 
+    if stats is None:
+        stats = {}
+    stats.setdefault("backpressure", 0)
     sent = 0
     t0 = time.perf_counter()
     if fmt == "grpc":
+        import grpc
         import grpc.aio
 
         from zipkin_tpu.server.grpc import METHOD
 
         gport = server._grpc.port
+
+        async def report_one(method, payload):
+            while True:
+                try:
+                    assert await method(payload) == b""
+                    return
+                except grpc.aio.AioRpcError as e:
+                    if e.code() is not grpc.StatusCode.RESOURCE_EXHAUSTED:
+                        raise
+                    stats["backpressure"] += 1
+                    await asyncio.sleep(0.005)
+
         async with grpc.aio.insecure_channel(
             f"127.0.0.1:{gport}",
             options=[("grpc.max_send_message_length", 64 << 20)],
@@ -72,7 +97,7 @@ async def _drive(server, port: int, fmt: str, payloads, batch: int,
                 while sent < total and len(pending) < 2:
                     pending.add(
                         asyncio.ensure_future(
-                            method(payloads[i % len(payloads)])
+                            report_one(method, payloads[i % len(payloads)])
                         )
                     )
                     i += 1
@@ -81,12 +106,26 @@ async def _drive(server, port: int, fmt: str, payloads, batch: int,
                     pending, return_when=asyncio.FIRST_COMPLETED
                 )
                 for d in done:
-                    assert d.result() == b""
+                    d.result()  # re-raise non-backpressure failures
     else:
         content_type = (
             "application/json" if fmt == "json" else "application/x-protobuf"
         )
         url = f"http://127.0.0.1:{port}/api/v2/spans"
+
+        async def post_one(sess, data):
+            while True:
+                resp = await sess.post(
+                    url, data=data, headers={"Content-Type": content_type}
+                )
+                status = resp.status
+                resp.release()
+                if status == 202:
+                    return
+                assert status == 429, status
+                stats["backpressure"] += 1
+                await asyncio.sleep(0.005)
+
         async with ClientSession(connector=TCPConnector(limit=4)) as sess:
             i = 0
             # two requests in flight: the server acks 202 on enqueue, so
@@ -96,10 +135,7 @@ async def _drive(server, port: int, fmt: str, payloads, batch: int,
                 while sent < total and len(pending) < 2:
                     pending.add(
                         asyncio.create_task(
-                            sess.post(
-                                url, data=payloads[i % len(payloads)],
-                                headers={"Content-Type": content_type},
-                            )
+                            post_one(sess, payloads[i % len(payloads)])
                         )
                     )
                     i += 1
@@ -108,9 +144,7 @@ async def _drive(server, port: int, fmt: str, payloads, batch: int,
                     pending, return_when=asyncio.FIRST_COMPLETED
                 )
                 for d in done:
-                    resp = d.result()
-                    assert resp.status == 202, resp.status
-                    resp.release()
+                    d.result()
     return time.perf_counter() - t0
 
 
@@ -162,7 +196,12 @@ async def _run_leg(leg: str, fmt: str, port: int, workers: int, payloads,
     warm = storage.ingest_counters()["spans"]
     elapsed = await _drive(server, port, fmt, payloads, batch, total)
     if server._mp_ingester is not None:
+        # the bounded per-worker queues can still hold whole un-parsed
+        # payloads when the last 202 lands — drain time is part of the
+        # honest wire-to-durable number, not a free tail
+        t1 = time.perf_counter()
         await asyncio.to_thread(server._mp_ingester.drain)
+        elapsed += time.perf_counter() - t1
     storage.agg.block_until_ready()
     accepted = storage.ingest_counters()["spans"] - warm
     await server.stop()
@@ -219,11 +258,34 @@ async def run() -> dict:
                 f" {legs[src]['spans_per_sec']:>13,.0f}",
                 file=sys.stderr,
             )
+        # fan-out scaling curve: the same full leg re-run with parse/pack
+        # moved onto N workers (tpu/mp_ingest.py). Comparable to the
+        # serial full row above; see the module docstring for the
+        # one-core-host caveat.
+        axis = [
+            int(w)
+            for w in os.environ.get(
+                "SERVER_BENCH_WORKERS_AXIS", "1,2,4"
+            ).split(",")
+            if w.strip()
+        ]
+        workers_axis = {}
+        for j, w in enumerate(axis):
+            r = await _run_leg(
+                "full", fmt, port + 3 + j, w, payloads, batch, total
+            )
+            workers_axis[str(w)] = r["spans_per_sec"]
+            print(
+                f"full@w{w:<4} {1e6 / r['spans_per_sec']:>8.3f}"
+                f" {r['spans_per_sec']:>13,.0f}",
+                file=sys.stderr,
+            )
         return {
             "metric": f"server_{fmt}_ingest_decomposition",
             "unit": "us/span",
             **table,
             "legs": {k: v["spans_per_sec"] for k, v in legs.items()},
+            "workers_axis": workers_axis,
             "format": fmt,
             "spans_per_leg": total,
         }

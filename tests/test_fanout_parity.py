@@ -1,0 +1,156 @@
+"""Fan-out tier durability parity (ingest fan-out PR, satellite 4).
+
+tests/test_mp_ingest.py proves device-state parity between the worker
+fan-out and the serial fast path; this file extends the claim through
+the DURABILITY plane: with the WAL attached and boundary sampling
+armed, the fan-out must produce the same sampling verdicts and a WAL
+whose replay reconstructs the same state — and a crash injected at
+``wal.append.mid`` while workers are live must recover exactly like
+the serial path does (tests/test_chaos_recovery.py oracle pattern).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.test_mp_ingest import (
+    CFG,
+    assert_state_parity,
+    payloads,
+    pytestmark,  # native codec gate applies here too  # noqa: F401
+)
+from tests.test_wal import assert_query_parity
+from zipkin_tpu import faults
+from zipkin_tpu.collector.core import CollectorSampler
+from zipkin_tpu.storage.tpu import TpuStorage
+from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+def make_wal(root):
+    return TpuStorage(
+        config=CFG, num_devices=2, batch_size=512,
+        checkpoint_dir=str(root / "ckpt"), wal_dir=str(root / "wal"),
+    )
+
+
+def test_workers1_wal_and_sampling_bit_parity(tmp_path):
+    """workers=1 processes payloads in submission order, so the fan-out
+    must be BIT-identical to the serial path all the way down: same
+    sampling verdicts (two same-rate samplers decide by trace id), same
+    device arrays, and WAL streams whose replays match each other
+    exactly — including vocab id assignment order."""
+    ps = payloads(n_payloads=3)
+    sync = make_wal(tmp_path / "sync")
+    for p in ps:
+        assert sync.ingest_json_fast(p, sampler=CollectorSampler(0.5)) \
+            is not None
+    mp_store = make_wal(tmp_path / "mp")
+    ing = MultiProcessIngester(
+        mp_store, workers=1, sampler=CollectorSampler(0.5)
+    )
+    try:
+        for p in ps:
+            ing.submit(p)
+        ing.drain()
+    finally:
+        ing.close()
+    assert ing.counters["fallbacks"] == 0
+    assert ing.counters["sampleDropped"] > 0  # the gate actually fired
+    assert_state_parity(sync, mp_store, exact_digest=True)
+    sync.close()
+    mp_store.close()
+
+    # WAL contents: both logs replay to the same state, ids included
+    r_sync = make_wal(tmp_path / "sync")
+    r_mp = make_wal(tmp_path / "mp")
+    assert_query_parity(r_sync, r_mp)
+    assert r_sync.vocab.services._names == r_mp.vocab.services._names
+    assert r_sync.vocab._key_list == r_mp.vocab._key_list
+    r_sync.close()
+    r_mp.close()
+
+
+def test_workers2_interleaved_wal_replay_parity(tmp_path):
+    """Two workers interleave arbitrarily; the WAL must still capture
+    every acked batch so a replay reconstructs the live store bit for
+    bit, and the replayed state stays semantically identical to the
+    serial path after id remapping."""
+    ps = payloads(n_payloads=4)
+    mp_store = make_wal(tmp_path / "mp")
+    ing = MultiProcessIngester(mp_store, workers=2, queue_depth=8)
+    try:
+        for p in ps:
+            ing.submit(p)
+        ing.drain()
+    finally:
+        ing.close()
+    sync = make_wal(tmp_path / "sync")
+    for p in ps:
+        assert sync.ingest_json_fast(p) is not None
+    assert_state_parity(sync, mp_store, exact_digest=False)
+
+    ha, la, _ = mp_store.agg.merged_sketches()
+    counters = dict(mp_store.agg.host_counters)
+    mp_store.close()
+    revived = make_wal(tmp_path / "mp")
+    assert revived.agg.host_counters == counters
+    hb, lb, _ = revived.agg.merged_sketches()
+    np.testing.assert_array_equal(ha, hb)
+    np.testing.assert_array_equal(la, lb)
+    assert_state_parity(sync, revived, exact_digest=False)
+    sync.close()
+    revived.close()
+
+
+def test_wal_append_crash_resume_with_workers_live(tmp_path):
+    """Crash injected at ``wal.append.mid`` (torn record: header on
+    disk, payload missing) while the worker pool is live and mid-
+    dispatch. The revived store must come up at exact parity with an
+    oracle fed only the durable prefix, and a FRESH pool on the revived
+    store must ingest the client's retry plus new traffic to full
+    parity — the fan-out changes nothing about the recovery contract."""
+    ps = payloads(n_payloads=5, spans_each=1024)
+    victim = make_wal(tmp_path / "mp")
+    ing = MultiProcessIngester(victim, workers=2, queue_depth=8)
+    for p in ps[:3]:
+        ing.submit(p)
+    ing.drain()  # ps[:3] durable (WAL-appended on the dispatch side)
+    faults.arm("wal.append.mid", action="raise")
+    ing.submit(ps[3])
+    with pytest.raises(RuntimeError):
+        ing.drain()
+    assert isinstance(ing._dispatch_error, faults.CrashpointTriggered)
+    ing.close()  # a dead dispatcher must not wedge teardown
+    del victim  # crash: HBM gone, torn record on disk
+
+    revived = make_wal(tmp_path / "mp")
+    oracle = TpuStorage(config=CFG, num_devices=2, batch_size=512)
+    for p in ps[:3]:
+        assert oracle.ingest_json_fast(p) is not None
+    assert_state_parity(oracle, revived, exact_digest=False)
+
+    # resume WITH workers: new pool, the client retries the unacked
+    # payload, traffic continues, and the result is durable again
+    ing2 = MultiProcessIngester(revived, workers=2, queue_depth=8)
+    try:
+        ing2.submit(ps[3])
+        ing2.submit(ps[4])
+        ing2.drain()
+    finally:
+        ing2.close()
+    for p in ps[3:]:
+        assert oracle.ingest_json_fast(p) is not None
+    assert_state_parity(oracle, revived, exact_digest=False)
+    counters = dict(revived.agg.host_counters)
+    revived.close()
+    revived2 = make_wal(tmp_path / "mp")
+    assert revived2.agg.host_counters == counters
+    revived2.close()
+    oracle.close()

@@ -121,3 +121,100 @@ def test_server_grpc_collector_gets_fast_ingest():
             await server.stop()
 
     _asyncio.run(scenario())
+
+
+def test_report_backpressure_maps_to_resource_exhausted():
+    """The fan-out tier's IngestBackpressure must surface as the gRPC
+    twin of HTTP 429 — RESOURCE_EXHAUSTED, the code grpc clients treat
+    as retry-after-backoff — not as an INTERNAL failure."""
+    from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
+
+    class PushbackCollector(Collector):
+        def accept_spans_bytes(self, data, encoding=None):
+            raise IngestBackpressure("every parse-worker queue is full")
+
+    async def scenario():
+        server = GrpcCollectorServer(
+            PushbackCollector(InMemoryStorage()), host="127.0.0.1", port=0
+        )
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{server.port}") as ch:
+                with pytest.raises(grpc.aio.AioRpcError) as err:
+                    await ch.unary_unary(METHOD)(proto3.encode_span_list(TRACE))
+                assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_report_records_grpc_boundary_stage():
+    """Report must time its boundary under the obs taxonomy's
+    grpc_boundary stage — parity with the HTTP tier's http_boundary."""
+    from zipkin_tpu import obs
+
+    async def scenario():
+        storage = InMemoryStorage()
+        server = GrpcCollectorServer(Collector(storage), host="127.0.0.1", port=0)
+        await server.start()
+        try:
+            before = obs.RECORDER.snapshot().stage("grpc_boundary").count
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{server.port}") as ch:
+                assert await ch.unary_unary(METHOD)(
+                    proto3.encode_span_list(TRACE)
+                ) == b""
+            after = obs.RECORDER.snapshot().stage("grpc_boundary").count
+            assert after == before + 1
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_report_b3_metadata_links_slow_dispatch_spans():
+    """B3 propagation parity with the HTTP middleware: x-b3-* request
+    metadata must be visible as CURRENT_B3 for the duration of the
+    accept (so slow-dispatch self-spans link to the caller's trace),
+    and x-b3-sampled: 0 must suppress the linkage per the B3 spec."""
+    from zipkin_tpu.obs.selfspans import CURRENT_B3
+
+    seen = []
+
+    class CapturingCollector(Collector):
+        def accept_spans_bytes(self, data, encoding=None):
+            seen.append(CURRENT_B3.get())
+            return super().accept_spans_bytes(data, encoding)
+
+    async def scenario():
+        storage = InMemoryStorage()
+        server = GrpcCollectorServer(
+            CapturingCollector(storage), host="127.0.0.1", port=0
+        )
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{server.port}") as ch:
+                method = ch.unary_unary(METHOD)
+                body = proto3.encode_span_list(TRACE)
+                await method(
+                    body,
+                    metadata=(
+                        ("x-b3-traceid", "cafecafecafecafe"),
+                        ("x-b3-spanid", "beefbeefbeefbeef"),
+                        ("x-b3-sampled", "1"),
+                    ),
+                )
+                await method(
+                    body,
+                    metadata=(
+                        ("x-b3-traceid", "cafecafecafecafe"),
+                        ("x-b3-spanid", "beefbeefbeefbeef"),
+                        ("x-b3-sampled", "0"),
+                    ),
+                )
+                await method(body)  # no metadata at all
+        finally:
+            await server.stop()
+
+    asyncio.run(scenario())
+    assert seen == [("cafecafecafecafe", "beefbeefbeefbeef"), None, None]

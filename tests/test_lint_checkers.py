@@ -624,3 +624,77 @@ def test_zt08_ignores_unrelated_record_methods(tmp_path):
         """,
     )
     assert rules(result) == []
+
+
+# -- ZT09: dispatch-critical loops ---------------------------------------
+
+
+ZT09_POSITIVE = """
+    def _handle(self, msg):  # zt-dispatch-critical: single dispatch core
+        for row in msg:
+            self.apply(row)
+"""
+
+
+def test_zt09_flags_loop_in_marked_function(tmp_path):
+    assert_rule_owned(tmp_path, ZT09_POSITIVE, "ZT09")
+
+
+def test_zt09_flags_comprehension_and_multiline_header(tmp_path):
+    # the marker may trail the closing paren of a multi-line signature
+    # (the columnar.remap_fused shape); comprehensions count as loops
+    result = lint(
+        tmp_path,
+        """
+        def remap(
+            fused, svc_map
+        ):  # zt-dispatch-critical: per-span id remap on the dispatch core
+            return [svc_map[s] for s in fused]
+        """,
+    )
+    assert rules(result) == ["ZT09"]
+
+
+def test_zt09_ignores_unmarked_functions(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        def worker_parse(payload):
+            return [s for s in payload]
+
+        def also_loops(rows):
+            for r in rows:
+                yield r
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt09_pragma_on_enclosing_statement_suppresses(tmp_path):
+    # comprehension findings anchor at the enclosing STATEMENT line, so
+    # a justified pragma above the statement suppresses (the mp_ingest
+    # vocab-journal shape: trip count is per new string, not per span)
+    result = lint(
+        tmp_path,
+        """
+        def _handle(self, new):  # zt-dispatch-critical: dispatch core
+            # zt-lint: disable=ZT09 — per NEWLY INTERNED string, bounded
+            # by vocab capacity, not per span
+            self.map = extend(
+                self.map, [self.intern(s) for s in new]
+            )
+        """,
+    )
+    assert rules(result) == []
+    assert len(result.suppressed) == 1
+
+
+def test_zt09_marker_without_reason_is_flagged(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        def _flush(self):  # zt-dispatch-critical
+            pass
+        """,
+        "ZT09",
+    )

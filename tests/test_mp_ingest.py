@@ -225,36 +225,51 @@ def test_multichunk_payload_drains_completely():
     assert_state_parity(sync, mp_store, exact_digest=True)
 
 
-def test_dead_worker_surfaces_error_instead_of_wedging():
-    """If a worker dies uncleanly (segfault/OOM), drain()/submit() must
-    raise instead of blocking forever on inflight counts the worker will
-    never complete (ADVICE r3: server shutdown used to wedge)."""
+def test_dead_worker_pool_exhaustion_recovers_not_wedges():
+    """workers=1 killed uncleanly (segfault/OOM): the reaper must refeed
+    the dead worker's in-flight payloads through the fallback path (zero
+    acked-span loss), release its _IdMaps, let drain() return normally,
+    and only then refuse NEW submissions with a pool-exhausted error —
+    recovery semantics, not the pre-fan-out raise-everything behavior."""
     import time
 
     from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
 
     mp_store = make_store()
+    ps = payloads(n_payloads=2, spans_each=512)
     ing = MultiProcessIngester(mp_store, workers=1)
     try:
+        ing.submit(ps[0])
         # simulate an OOM-kill: SIGKILL, no EOF message ever sent
         ing._procs[0].kill()
+        # _maps[w] = None is the reap's per-worker release step — the
+        # leak fix under test: id tables must not stay pinned for the
+        # pool's lifetime after the worker is gone
         deadline = time.monotonic() + 30
-        while ing._dispatch_error is None and time.monotonic() < deadline:
+        while ing._maps[0] is not None and time.monotonic() < deadline:
             time.sleep(0.05)
-        assert ing._dispatch_error is not None, "dead worker never detected"
-        with pytest.raises(RuntimeError):
-            ing.submit(payloads(1)[0])
-        with pytest.raises(RuntimeError):
-            ing.drain()
+        assert ing._maps[0] is None, "dead worker never reaped"
+        assert ing._dead == {0}
+        # drain() returns: the reap either saw the payload's completion
+        # or refed it via fallback, so inflight went to zero either way
+        ing.drain()
+        # zero acked-span loss — the submitted payload landed exactly once
+        assert mp_store.agg.host_counters["spans"] == 512
+        with pytest.raises(RuntimeError, match="exhausted"):
+            ing.submit(ps[1])
+        assert ing._dispatch_error is None
     finally:
+        t0 = time.monotonic()
         ing.close()  # must not hang either
+        assert time.monotonic() - t0 < 25, "close() wedged after pool death"
 
 
-def test_dead_worker_does_not_wedge_survivors():
-    """workers=2 under traffic, one killed: the dispatcher's sink mode
-    must keep releasing shm slots so the SURVIVING worker never blocks
-    in slot_sem.acquire(), and close() returns promptly instead of
-    burning its 30 s join timeout and terminating a healthy worker."""
+def test_dead_worker_survivors_keep_accepting_zero_loss():
+    """workers=2 under traffic, one killed: the pool must keep running
+    on the survivor — submissions after the reap are accepted (no raise),
+    drain() returns, and EVERY submitted span lands exactly once (the
+    dead worker's in-flight payloads are refed via fallback, buffered
+    partial chunks discarded so nothing double-ingests)."""
     import time
 
     from zipkin_tpu.tpu.mp_ingest import MultiProcessIngester
@@ -266,21 +281,66 @@ def test_dead_worker_does_not_wedge_survivors():
         for p in ps[:3]:
             ing.submit(p)
         ing._procs[0].kill()
-        # keep traffic flowing at the survivor while the reap runs
-        for p in ps[3:]:
-            try:
-                ing.submit(p)
-            except RuntimeError:
-                break
         deadline = time.monotonic() + 30
-        while ing._dispatch_error is None and time.monotonic() < deadline:
+        while ing._maps[0] is not None and time.monotonic() < deadline:
             time.sleep(0.05)
-        assert ing._dispatch_error is not None
+        assert ing._maps[0] is None, "dead worker never reaped"
+        assert ing._dead == {0}
+        assert ing.stats()["mpWorkersAlive"] == 1
+        # traffic keeps flowing at the survivor AFTER the reap
+        for p in ps[3:]:
+            ing.submit(p)
+        ing.drain()
+        assert ing._dispatch_error is None
+        # zero acked-span loss across the kill: all six payloads applied
+        assert mp_store.agg.host_counters["spans"] == 6 * 1024
     finally:
         t0 = time.monotonic()
         ing.close()
         # survivor must have exited via its sentinel, not terminate()
         assert time.monotonic() - t0 < 25, "close() wedged on survivor"
+
+
+def test_backpressure_bounded_queues_push_back_then_recover():
+    """With the lone worker frozen (SIGSTOP), the bounded per-worker
+    queue fills and a non-blocking submit must raise IngestBackpressure
+    — the signal app.py maps to HTTP 429 / grpc.py to RESOURCE_EXHAUSTED
+    — without leaking the rejected payload into inflight accounting.
+    After SIGCONT every ACCEPTED payload lands exactly once."""
+    import os
+    import signal
+
+    from zipkin_tpu.tpu.mp_ingest import (
+        IngestBackpressure,
+        MultiProcessIngester,
+    )
+
+    mp_store = make_store()
+    ps = payloads(n_payloads=8, spans_each=256)
+    ing = MultiProcessIngester(mp_store, workers=1, queue_depth=2)
+    try:
+        os.kill(ing._procs[0].pid, signal.SIGSTOP)
+        accepted = 0
+        try:
+            with pytest.raises(IngestBackpressure):
+                for p in ps:
+                    ing.submit(p, block=False)
+                    accepted += 1
+        finally:
+            os.kill(ing._procs[0].pid, signal.SIGCONT)
+        # the queue bound is real: at most depth + whatever the worker
+        # drained pre-freeze fit; the rest pushed back
+        assert ing.queue_depth <= accepted < len(ps)
+        assert ing.counters["rejected"] == 1
+        # a rejected submit must not wedge drain (registration rollback)
+        ing.drain()
+        assert mp_store.agg.host_counters["spans"] == 256 * accepted
+        # backpressure is transient: the pool accepts again once drained
+        ing.submit(ps[-1], block=False)
+        ing.drain()
+        assert mp_store.agg.host_counters["spans"] == 256 * (accepted + 1)
+    finally:
+        ing.close()
 
 
 def test_sampler_parity():

@@ -174,9 +174,9 @@ class TestTaxonomy:
 
     def test_issue_stage_names_all_present(self):
         expected = {
-            "http_boundary", "parse", "pack", "route", "device_dispatch",
-            "rollup", "ctx_advance", "wal_append", "wal_fsync", "snapshot",
-            "sampler_tick", "archive_write", "query_fresh", "query_cached",
-            "readpack_transfer", "mp_record",
+            "http_boundary", "grpc_boundary", "parse", "pack", "route",
+            "device_dispatch", "rollup", "ctx_advance", "wal_append",
+            "wal_fsync", "snapshot", "sampler_tick", "archive_write",
+            "query_fresh", "query_cached", "readpack_transfer", "mp_record",
         }
         assert set(STAGES) == expected

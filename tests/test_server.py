@@ -426,3 +426,35 @@ class TestUiPage:
             assert "Content-Security-Policy" not in resp.headers
 
         run(scenario)
+
+
+class TestFanoutBackpressure:
+    def test_full_worker_queues_map_to_429(self):
+        """IngestBackpressure from the parse fan-out tier is the
+        client's retry-after-backoff signal (429), distinct from the
+        reader-throttle's 503 — a load balancer must be able to tell
+        "slow down" from "node unhealthy"."""
+        from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
+
+        async def wrapper():
+            server = ZipkinServer(ServerConfig())
+
+            def pushback(body, encoding=None):
+                raise IngestBackpressure(
+                    "every parse-worker queue is full (2 workers x depth 2)"
+                )
+
+            server.collector.accept_spans_bytes = pushback
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v2/spans", data=post_trace_body(),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 429
+                assert "queue is full" in await resp.text()
+            finally:
+                await client.close()
+
+        asyncio.run(wrapper())

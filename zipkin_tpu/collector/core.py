@@ -166,22 +166,34 @@ class Collector:
         """
         self.metrics.increment_messages()
         self.metrics.increment_bytes(len(data))
+        _MP = (codec.Encoding.JSON_V2, codec.Encoding.PROTO3)
         if (
             self.mp_ingester is not None
             # MP is the fast path's scale-out: it keeps the fast path's
             # sampled-archive semantics, so it must never preempt the
             # full-fidelity object path when fast ingest is off
             and self.fast_ingest
-            and (encoding is None or encoding is codec.Encoding.JSON_V2)
+            and (encoding is None or encoding in _MP)
         ):
-            if encoding is not None or codec.detect(data) is codec.Encoding.JSON_V2:
+            if encoding is not None or codec.detect(data) in _MP:
                 # span/drop counters are incremented by the dispatcher as
                 # batches land (the ingester holds this collector's
                 # metrics); 0 = accepted asynchronously. A malformed
                 # payload is counted + logged by the dispatcher instead
                 # of HTTP-400'd — the at-least-once transports share
-                # this poison-pill semantic (SURVEY.md §3.3).
-                self.mp_ingester.submit(data)
+                # this poison-pill semantic (SURVEY.md §3.3). proto3
+                # rides the same fan-out: the workers' native parser
+                # sniffs the wire format (ISSUE 8).
+                from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
+
+                try:
+                    # non-blocking at the boundary: a full tier must
+                    # surface as 429/RESOURCE_EXHAUSTED, not as the
+                    # event loop's to_thread pool silently queueing
+                    self.mp_ingester.submit(data, block=False)
+                except IngestBackpressure:
+                    self.metrics.increment_messages_dropped()
+                    raise
                 return 0
         # the native tier parses JSON v2 AND proto3 ListOfSpans (r4:
         # gRPC/proto3 ingest was the one first-class hot codec still on

@@ -23,10 +23,15 @@ Rule catalog (grounded in real past regressions — see ARCHITECTURE.md
   device-traced code (host instrumentation runs once at trace time),
   or a stage argument outside the closed taxonomy in
   ``obs/stages.py``.
+- ZT09 dispatch-critical loops: Python ``for``/``while``/comprehensions
+  inside functions marked ``# zt-dispatch-critical`` — the ingest
+  fan-out's single dispatch core must do O(chunks)+O(new-vocab) work,
+  never O(spans); justified non-per-span loops carry ZT09 pragmas.
 """
 
 from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
     blocking,
+    dispatchloop,
     donation,
     freshread,
     locks,

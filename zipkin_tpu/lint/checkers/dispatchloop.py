@@ -1,0 +1,100 @@
+"""ZT09 — dispatch-core critical sections stay free of per-span loops.
+
+The ingest fan-out tier (tpu/mp_ingest.py) exists because parse/pack is
+Python-speed work: N workers each own it, and ONE dispatch core applies
+their output to the device. The whole pool's ceiling is therefore the
+dispatch core's per-payload cost — which must be O(new vocab entries) +
+O(chunks), never O(spans). A per-span Python ``for``/``while``/
+comprehension slipping into that section (the historical shape: "just
+iterate the record rows to remap them") silently caps N workers at one
+interpreter's speed, and no unit test notices because correctness is
+unaffected.
+
+Functions opt in by carrying a ``# zt-dispatch-critical: <reason>``
+marker comment on their ``def`` header (any header line up to the start
+of the body, so multi-line signatures work). Inside a marked function
+every loop or comprehension is flagged; loops whose trip count is
+provably NOT per-span carry a standard ``zt-lint: disable=ZT09`` pragma
+whose justification says what the trip count actually is (per new
+string, per chunk, ...) — the pragma audit IS the documentation that
+the critical section stayed vectorized.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from zipkin_tpu.lint.core import Checker, Module, register
+
+_FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOOP_KINDS = (ast.For, ast.AsyncFor, ast.While)
+_COMP_KINDS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+MARKER_RE = re.compile(r"#\s*zt-dispatch-critical\b(?P<rest>.*)$")
+
+
+def _marker(module: Module, fn: ast.AST):
+    """The zt-dispatch-critical marker on fn's header lines, if any.
+
+    The header is everything from the ``def`` line up to (not
+    including) the first body statement's line — the marker may trail
+    the closing paren of a multi-line signature."""
+    end = fn.body[0].lineno if fn.body else fn.lineno + 1
+    for line_no in range(fn.lineno, end):
+        m = MARKER_RE.search(module.line_text(line_no))
+        if m:
+            return line_no, m.group("rest")
+    return None
+
+
+@register
+class DispatchCriticalLoops(Checker):
+    rule = "ZT09"
+    severity = "error"
+    name = "dispatch-critical-loops"
+    doc = (
+        "Python loops/comprehensions inside functions marked "
+        "zt-dispatch-critical (the single-threaded dispatch core of the "
+        "ingest fan-out)"
+    )
+    hint = (
+        "vectorize over the batch (numpy LUT / fancy indexing), or if "
+        "the trip count is per-chunk/per-new-vocab-entry — not per-span "
+        "— justify it with a zt-lint: disable=ZT09 pragma saying so"
+    )
+
+    def check(self, module: Module):
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, _FUNC_KINDS):
+                continue
+            marked = _marker(module, fn)
+            if marked is None:
+                continue
+            line_no, rest = marked
+            if not rest.lstrip().startswith(":") or not rest.lstrip(": ").strip():
+                yield self.found(
+                    module, fn,
+                    "zt-dispatch-critical marker without a reason — say "
+                    "WHY this function is on the dispatch core's critical "
+                    "path (# zt-dispatch-critical: <reason>)",
+                )
+            for node in ast.walk(fn):
+                if isinstance(node, _LOOP_KINDS):
+                    shape = "loop"
+                elif isinstance(node, _COMP_KINDS):
+                    shape = "comprehension"
+                else:
+                    continue
+                # anchor at the enclosing STATEMENT: a comprehension's
+                # own line is mid-expression, where no pragma can sit —
+                # the suppression audit lives on the statement line
+                anchor = node
+                while anchor is not None and not isinstance(anchor, ast.stmt):
+                    anchor = module.parents.get(anchor)
+                yield self.found(
+                    module, anchor or node,
+                    f"Python {shape} inside dispatch-critical "
+                    f"{fn.name}() — a per-span trip count here caps "
+                    "every parse worker at one interpreter's speed",
+                )

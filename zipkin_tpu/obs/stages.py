@@ -21,6 +21,7 @@ compiles in tests; scale them with ``TPU_OBS_BUDGET_SCALE``.
 
 STAGES = (
     "http_boundary",     # request body read → collector hand-off (server side)
+    "grpc_boundary",     # gRPC Report: request bytes → collector hand-off
     "parse",             # wire bytes → columnar/object spans (C parser or codec)
     "pack",              # parsed spans → packed device wire image
     "route",             # shard routing of a fused batch
@@ -44,6 +45,7 @@ STAGE_INDEX = {name: i for i, name in enumerate(STAGES)}
 # Slow-span budgets, µs, scaled by TPU_OBS_BUDGET_SCALE at install time.
 DEFAULT_BUDGETS_US = {
     "http_boundary": 500_000,
+    "grpc_boundary": 500_000,
     "parse": 250_000,
     "pack": 250_000,
     "route": 250_000,

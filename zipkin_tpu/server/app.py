@@ -43,6 +43,7 @@ from zipkin_tpu.server.config import ServerConfig
 from zipkin_tpu.storage.memory import InMemoryStorage
 from zipkin_tpu.storage.spi import QueryRequest, StorageComponent
 from zipkin_tpu.storage.throttle import RejectedExecutionError
+from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
 from zipkin_tpu.utils.component import Component
 
 logger = logging.getLogger(__name__)
@@ -159,8 +160,14 @@ class ZipkinServer:
                     core,
                     workers=self.config.tpu_mp_workers,
                     sampler=sampler,
+                    queue_depth=self.config.tpu_mp_queue_depth,
                     metrics=http_metrics,
                 )
+                # surface the tier's gauges on ingest_counters() —
+                # /metrics, /prometheus and /statusz all read it — and
+                # let the storage adapter drain/close an attached tier
+                # if the server's stop() never ran
+                core.mp_ingester = self._mp_ingester
             else:
                 logger.warning(
                     "TPU_MP_WORKERS=%d ignored: requires STORAGE_TYPE=tpu, "
@@ -295,6 +302,10 @@ class ZipkinServer:
                     # HTTP rides the native parser — the r4 "line-rate
                     # gRPC" claim depends on the fast path here too
                     fast_ingest=self.config.tpu_fast_ingest,
+                    # SpanService/Report routes into the SAME parse
+                    # fan-out as HTTP (ISSUE 8): proto3 is the tier's
+                    # preferred wire, not the odd one out
+                    mp_ingester=self._mp_ingester,
                 ),
                 host=self.config.host,
                 port=self.config.grpc_port,
@@ -448,6 +459,11 @@ class ZipkinServer:
             # storage throttle shed the write: tell the sender to back off
             # (reference behavior for RejectedExecutionException)
             return web.Response(status=503, text=str(e))
+        except IngestBackpressure as e:
+            # every parse-worker queue in the fan-out tier is full: 429
+            # (Too Many Requests) — transient, retryable, distinct from
+            # the throttle's 503 so dashboards can tell the tiers apart
+            return web.Response(status=429, text=str(e))
         # body read → collector hand-off complete; the 202 ack follows
         obs.record("http_boundary", time.perf_counter() - t0)
         return web.Response(status=202)
@@ -677,6 +693,14 @@ class ZipkinServer:
         if hasattr(self.storage, "ingest_counters"):
             counters = await asyncio.to_thread(self.storage.ingest_counters)
             for name in ("ctxDeltaLanes", "ctxAdvances", "ctxMaintenanceMs"):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
+            # fan-out tier gauges (ISSUE 8): pool size/health, bounded-queue
+            # posture, and the acked-span accounting that proves zero loss
+            for name in (
+                "mpWorkers", "mpWorkersAlive", "mpQueueDepth", "mpInflight",
+                "mpAccepted", "mpSampleDropped", "mpFallbacks", "mpRejected",
+            ):
                 if name in counters:
                     out[f"gauge.zipkin_tpu.{name}"] = counters[name]
         # sampling-tier gauges (ISSUE 4): retention verdict tallies, the

@@ -74,6 +74,10 @@ class ServerConfig:
     tpu_fast_ingest: bool = False  # line-rate JSON->device path
     tpu_fast_archive_sample: int = 64  # 1/N traces archived in fast mode
     tpu_mp_workers: int = 0  # >0: multi-process parse tier (mp_ingest)
+    # per-worker payload bound of the fan-out tier's queues: when every
+    # live worker's queue is full the boundary answers HTTP 429 / gRPC
+    # RESOURCE_EXHAUSTED instead of buffering unboundedly
+    tpu_mp_queue_depth: int = 2
     # one-knob durable boot (ISSUE 3): TPU_RESUME_DIR=<dir> defaults
     # checkpoint/WAL/archive under <dir>/{snap,wal,archive} so boot runs
     # the full restore sequence — snapshot restore, WAL-tail replay,
@@ -180,6 +184,7 @@ class ServerConfig:
             tpu_fast_ingest=fast_ingest,
             tpu_fast_archive_sample=_env_int("TPU_FAST_ARCHIVE_SAMPLE", 64),
             tpu_mp_workers=_env_int("TPU_MP_WORKERS", 0),
+            tpu_mp_queue_depth=_env_int("TPU_MP_QUEUE_DEPTH", 2),
             tpu_resume_dir=resume_dir,
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR")
             or (os.path.join(resume_dir, "snap") if resume_dir else None),

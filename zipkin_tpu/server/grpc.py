@@ -10,19 +10,34 @@ wire format.
 The request body IS a ``ListOfSpans`` (the same bytes the HTTP collector
 accepts as application/x-protobuf); the response is an empty
 ``ReportResponse``.
+
+Observability parity with the HTTP site (ISSUE 8): every Report records
+the ``grpc_boundary`` obs stage (request bytes → collector hand-off), so
+the fan-out tier's gRPC leg shows up on ``/statusz`` and the stage
+histograms exactly like HTTP ingest does. Incoming B3 ids on the
+invocation metadata (``x-b3-traceid``/``x-b3-spanid``, the lowercase
+metadata forms of the B3 headers) are published to
+``obs.selfspans.CURRENT_B3`` for the duration of the call — contextvars
+survive ``asyncio.to_thread`` — so slow-dispatch self-spans triggered
+while serving a gRPC report parent under the caller's trace, matching
+the HTTP self-tracing middleware. ``x-b3-sampled: 0`` suppresses the
+linkage per the B3 spec.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import grpc
 import grpc.aio
 
+from zipkin_tpu import obs
 from zipkin_tpu.collector.core import Collector
 from zipkin_tpu.model.codec import Encoding
+from zipkin_tpu.obs.selfspans import CURRENT_B3
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +54,13 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
             return None
 
         async def report(request: bytes, context) -> bytes:
+            t0 = time.perf_counter()
+            md = dict(context.invocation_metadata() or ())
+            tid, sid = md.get("x-b3-traceid"), md.get("x-b3-spanid")
+            sampled = str(md.get("x-b3-sampled", "")).lower()
+            token = None
+            if tid and sid and sampled not in ("0", "false"):
+                token = CURRENT_B3.set((tid, sid))
             try:
                 # off the event loop: decode + device ingest block, and the
                 # loop is shared with the HTTP site (same fix as app.py)
@@ -47,8 +69,15 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
                 )
             except ValueError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            except Exception as e:  # storage rejection -> retryable
+            except Exception as e:
+                # storage rejection -> retryable; IngestBackpressure (the
+                # fan-out tier's bounded queues are full) lands here too,
+                # the gRPC twin of the HTTP site's 429
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+            finally:
+                if token is not None:
+                    CURRENT_B3.reset(token)
+            obs.record("grpc_boundary", time.perf_counter() - t0)
             return b""  # empty ReportResponse
 
         return grpc.unary_unary_rpc_method_handler(

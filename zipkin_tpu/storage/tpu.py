@@ -9,10 +9,13 @@ archive bound, checkpoint wiring).
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 from zipkin_tpu.tpu.state import AggConfig
 from zipkin_tpu.tpu.store import TpuStorage as _CoreTpuStorage
+
+logger = logging.getLogger(__name__)
 
 
 class TpuStorage(_CoreTpuStorage):
@@ -189,6 +192,23 @@ class TpuStorage(_CoreTpuStorage):
         return path
 
     def close(self) -> None:
+        # an attached MP fan-out tier (server sets .mp_ingester) must be
+        # drained + torn down BEFORE the WAL detaches: its dispatcher
+        # feeds ingest_fused, whose wal_hook is the durability seam —
+        # closing the segment under live dispatch would strand 202-acked
+        # spans. The server's stop() normally does this (and close() is
+        # idempotent); this is the belt for embedders/benches that only
+        # call storage.close().
+        ing = getattr(self, "mp_ingester", None)
+        if ing is not None:
+            try:
+                if ing._dispatch_error is None and not ing._closed:
+                    ing.drain()
+            except Exception:
+                logger.exception("mp-ingest drain failed during close")
+            finally:
+                ing.close()
+                self.mp_ingester = None
         # serialize with snapshot(): a snapshot mid-flight finishes
         # before teardown, and any later attempt sees _closed
         with self._snapshot_lock:

@@ -486,6 +486,30 @@ def route_fused(
     return out
 
 
+def remap_fused(
+    fused: np.ndarray, svc_map: np.ndarray, key_map: np.ndarray
+) -> None:  # zt-dispatch-critical: per-span id remap on the dispatch core
+    """Remap a packed wire image's service/key id lanes in place through
+    ``svc_map``/``key_map`` lookup tables (u32, indexed by old id).
+
+    This is the dispatch-core half of the MP fan-out's worker-local
+    interning: workers intern against private vocabs, and the dispatcher
+    rewrites row 9 (``svc << 16 | rsvc``) and row 10's key field
+    (``key << 8 | flags``) local -> global with three vectorized table
+    lookups. Lives here so the packed-row layout is defined in exactly
+    one module (see :func:`fuse_columns`). Accepts ``[F, n]`` and
+    ``[shards, F, n]`` images alike.
+    """
+    sr = fused[..., 9, :]
+    fused[..., 9, :] = (svc_map[sr >> _U32(16)] << _U32(16)) | svc_map[
+        sr & _U32(0xFFFF)
+    ]
+    kf = fused[..., 10, :]
+    fused[..., 10, :] = (key_map[kf >> _U32(8)] << _U32(8)) | (
+        kf & _U32(0xFF)
+    )
+
+
 def route_columns(
     cols: SpanColumns, n_shards: int, pad_to_multiple: int = 256
 ) -> SpanColumns:

@@ -1,26 +1,48 @@
-"""Multi-process parse/pack tier feeding the device aggregator.
+"""Multi-process parse/pack fan-out feeding the single dispatch core.
 
 The reference scales ingest horizontally with N collector workers/nodes
 (Kafka partition parallelism, ``KafkaCollector.java`` — SURVEY.md §2.8);
 under CPython one process cannot: the r2 profile measured the device path
-at ~490k spans/s/chip with the host parse GIL-serialized at ~231k
-end-to-end, and a threaded feeder measured SLOWER (tpu/feeder.py). This
-module is the multi-process analog the round-2 verdict ordered:
+at ~490k spans/s/chip with the host parse GIL-serialized, and a threaded
+feeder measured SLOWER (tpu/feeder.py). This module is the multi-process
+fan-out tier (ISSUE 8), the collector's real fast path for both JSON v2
+and proto3 payloads over HTTP and gRPC:
 
-- **N parse workers** (``spawn``, never importing jax): raw JSON bytes ->
-  native C parse + LOCAL vocab interning -> columnar pack -> trace-affine
-  shard routing -> the packed 11-row wire image written into a shared-
-  memory slot. Workers journal newly-interned strings per batch.
+- **N parse workers** (``spawn``, never importing jax): raw JSON/proto3
+  bytes -> native C parse + LOCAL vocab interning -> columnar pack ->
+  trace-affine shard routing -> the packed 11-row wire image written into
+  a shared-memory slot. Workers journal newly-interned strings per batch
+  and ship their parse/pack/route wall time so the obs stage taxonomy
+  covers the tier end-to-end.
 - **One dispatcher thread** (main process, owns the device): applies each
   worker's vocab journal to the GLOBAL vocab, remaps the image's packed
-  service/key lanes worker-local -> global with three vectorized table
-  lookups, then ``ingest_fused`` (device_put + jit step). Remapping is
-  what lets workers intern lock-free: ids only need to be consistent
-  per-worker, the journal replays them into one global id space.
+  service/key lanes worker-local -> global with vectorized table lookups
+  (``columnar.remap_fused``), then ``ingest_fused`` (device_put + jit
+  step). WAL append and sampling verdicts ride ``ingest_fused`` on this
+  side, so ack-after-durability semantics are bit-identical to the
+  serial path. Remapping is what lets workers intern lock-free: ids only
+  need to be consistent per-worker; the journal replays them into one
+  global id space.
+
+Backpressure contract: each worker owns a BOUNDED queue. ``submit(...,
+block=False)`` — the server-boundary mode — raises
+:class:`IngestBackpressure` when every live worker's queue is full; the
+HTTP site maps it to 429 and the gRPC site to RESOURCE_EXHAUSTED so
+senders back off instead of the tier buffering unboundedly.
+
+Zero-loss worker death: the dispatcher retains every submitted payload
+(``_pending``) until its results are APPLIED, and buffers per-payload
+state mutations until the payload's completion chunk arrives. A worker
+that dies mid-payload therefore loses nothing: its buffered chunks are
+discarded (never applied, so no double-ingest) and every payload it
+owned — queued or in-process — re-ingests on the slow path. The pool
+keeps serving on the survivors; only a dead DISPATCHER (device failure)
+surfaces as an error to submit()/drain().
 
 Sampled archive parity: workers extract the same trace-affine 1/N span
 slices the synchronous fast path archives (byte extents from the native
-parser); the dispatcher re-decodes them with the reference codec, so
+parser); the dispatcher re-decodes them with the reference codec
+(format-sniffing, so proto3 payloads archive too), and
 ``/api/v2/trace/{id}`` serves identical spans whichever tier ingested.
 
 On a single-core host this tier cannot beat the synchronous path (the
@@ -37,7 +59,7 @@ import multiprocessing as mp
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
@@ -51,8 +73,15 @@ _KIND_FALLBACK = 1
 _KIND_EOF = 2
 
 
+class IngestBackpressure(RuntimeError):
+    """Every live parse worker's queue is full: the fan-out tier is
+    saturated. Raised by ``submit(..., block=False)``; the server
+    boundary maps it to HTTP 429 / gRPC RESOURCE_EXHAUSTED so senders
+    back off and retry instead of the tier buffering unboundedly."""
+
+
 def _extract_archive_slices(parsed, every: int) -> List[bytes]:
-    """The worker half of TpuStorage._archive_fast_sample: the exact JSON
+    """The worker half of TpuStorage._archive_fast_sample: the exact raw
     byte extents of the trace-affine 1/N sample (same hash rule, so the
     MP tier archives the same spans the sync path would)."""
     from zipkin_tpu.tpu.columnar import _mix32
@@ -101,18 +130,21 @@ def _worker_main(
     sent_svc, sent_name, sent_pair = 1, 1, 1
     slot_ids = itertools.cycle(range(n_slots))
 
-    def handle(payload: bytes, state: dict) -> None:
+    def handle(pid: int, payload: bytes, state: dict) -> None:
         nonlocal sent_svc, sent_name, sent_pair
+        t0 = time.perf_counter()
+        # parse_spans sniffs the wire format: JSON v2 and proto3
+        # ListOfSpans both land here, so the fan-out is format-agnostic
         parsed = (
             native.parse_spans(payload, nvocab=nvocab)
             if nvocab is not None
             else None
         )
         if parsed is None:
-            # the strict-codec fallback needs Span objects: punt the
-            # raw payload back to the dispatcher's slow path
+            # the strict-codec fallback needs Span objects: punt back to
+            # the dispatcher, which still holds the payload bytes
             state["completed"] = True
-            result_q.put((_KIND_FALLBACK, widx, payload))
+            result_q.put((_KIND_FALLBACK, widx, pid))
             return
         nvocab.sync()
         n = parsed.n
@@ -127,11 +159,12 @@ def _worker_main(
                     if col is not None:
                         setattr(parsed, field, col[:n][idx])
                 parsed.n = n = len(idx)
+        parse_s = time.perf_counter() - t0
         if n == 0:
             state["completed"] = True
             result_q.put(
-                (_KIND_BATCH, widx, None, None, 0, 0, 0, dropped,
-                 [], [], [], [], (0, 0), None)
+                (_KIND_BATCH, widx, pid, None, None, 0, 0, 0, dropped,
+                 [], [], [], [], (0, 0), None, parse_s, 0.0, 0.0)
             )
             return
         for lo in range(0, n, max_batch):
@@ -145,8 +178,12 @@ def _worker_main(
                     col = getattr(parsed, f, None)
                     setattr(sub, f, None if col is None else col[lo:hi])
                 sub.n = hi - lo
+            t1 = time.perf_counter()
             cols = pack_parsed(sub, vocab, pad)
+            t2 = time.perf_counter()
             fused = route_fused(cols, n_shards)
+            route_s = time.perf_counter() - t2
+            pack_s = t2 - t1
             arch = _extract_archive_slices(sub, every)
             rec = parsed_record(sub) if disk else None
             # vocab journal since the last report (id order)
@@ -169,55 +206,47 @@ def _worker_main(
                 if live_ts.size
                 else (0, 0)
             )
-            # -1 marks a continuation chunk: the dispatcher decrements
-            # inflight once per PAYLOAD, on the LAST chunk's message —
-            # not the first, or drain() could return while later chunks
-            # are still queued/being packed and miss spans the caller
-            # was promised (ADVICE r3). The sampled-drop count rides the
-            # completion chunk.
+            # -1 marks a continuation chunk: the dispatcher completes a
+            # payload (applies its buffered chunks, decrements inflight)
+            # on the LAST chunk's message only, so drain() can never
+            # return while later chunks are still queued or being packed
+            # (ADVICE r3). The sampled-drop count and the parse timing
+            # ride the completion chunk.
             is_last = hi == n
-            state["shipped"] = True
             if is_last:
                 state["completed"] = True
             result_q.put(
                 (
-                    _KIND_BATCH, widx, slot, fused.shape,
+                    _KIND_BATCH, widx, pid, slot, fused.shape,
                     int(cols.valid.sum()),
                     int((cols.valid & cols.has_dur).sum()),
                     int((cols.valid & cols.err).sum()),
                     dropped if is_last else -1,
                     svc_new, name_new, pairs_new, arch, ts_range, rec,
+                    parse_s if is_last else 0.0, pack_s, route_s,
                 )
             )
+            parse_s = 0.0  # only bill the parse once per payload
 
     try:
         while True:
             item = work_q.get()
             if item is None:
                 break
+            pid, payload = item
             state: dict = {"completed": False}
             try:
-                handle(item, state)
+                handle(pid, payload, state)
             except Exception:  # pragma: no cover - keep the pool alive
                 logging.getLogger(__name__).exception(
                     "mp-ingest worker %d failed on a payload", widx
                 )
                 if not state["completed"]:
-                    if not state.get("shipped"):
-                        # nothing reached the dispatcher: whole payload
-                        # retries on the slow path
-                        result_q.put((_KIND_FALLBACK, widx, item))
-                    else:
-                        # some chunks shipped without the completion
-                        # marker — ship an empty completion record so
-                        # inflight still decrements and drain() cannot
-                        # hang. A fallback retry here would double-ingest
-                        # the shipped chunks; the un-shipped tail is lost
-                        # instead — logged above, bounded to one payload.
-                        result_q.put(
-                            (_KIND_BATCH, widx, None, None, 0, 0, 0, 0,
-                             [], [], [], [], (0, 0), None)
-                        )
+                    # the dispatcher buffers chunk application until the
+                    # completion marker, so any chunks this payload DID
+                    # ship were never applied: a whole-payload fallback
+                    # retry cannot double-ingest, and nothing is lost
+                    result_q.put((_KIND_FALLBACK, widx, pid))
     finally:
         result_q.put((_KIND_EOF, widx))
         shm.close()
@@ -239,12 +268,15 @@ class _IdMaps:
 class MultiProcessIngester:
     """Owns the worker pool + shared-memory slots + dispatcher thread.
 
-    ``submit(payload)`` enqueues raw JSON v2 bytes and returns once the
-    payload is accepted for processing (backpressure: the work queue is
-    bounded). ``drain()`` blocks until everything submitted has reached
-    the device. Parity with ``TpuStorage.ingest_json_fast`` — same
-    sketches, same sampled archive — is asserted in
-    tests/test_mp_ingest.py.
+    ``submit(payload)`` enqueues raw JSON v2 / proto3 bytes onto one
+    worker's bounded queue and returns once the payload is accepted.
+    ``submit(payload, block=False)`` — the server boundary's mode —
+    raises :class:`IngestBackpressure` instead of blocking when every
+    live worker's queue is full. ``drain()`` blocks until everything
+    submitted has reached the device. Parity with
+    ``TpuStorage.ingest_json_fast`` — same sketches, same sampling
+    verdicts, same WAL contents — is asserted in tests/test_mp_ingest.py
+    and tests/test_fanout_parity.py.
     """
 
     def __init__(
@@ -263,6 +295,7 @@ class MultiProcessIngester:
             raise RuntimeError("native codec unavailable; MP tier needs it")
         self.store = store
         self.workers = workers
+        self.queue_depth = queue_depth or 2  # PER-WORKER payload bound
         self._sampler = sampler
         agg = store.agg
         # worst case: every span of a max_batch chunk routes to one
@@ -277,7 +310,11 @@ class MultiProcessIngester:
         from multiprocessing import shared_memory
 
         self._shm = shared_memory.SharedMemory(create=True, size=total)
-        self._work_q = ctx.Queue(maxsize=queue_depth or 2 * workers)
+        # one bounded queue per worker: backpressure is per-worker, and a
+        # dead worker's queue can be salvaged without racing survivors
+        self._work_qs = [
+            ctx.Queue(maxsize=self.queue_depth) for _ in range(workers)
+        ]
         self._result_q = ctx.Queue()
         self._sems = [ctx.Semaphore(slots_per_worker) for _ in range(workers)]
         has_disk = getattr(store, "_disk", None) is not None
@@ -309,7 +346,7 @@ class MultiProcessIngester:
             ctx.Process(
                 target=_worker_main,
                 args=(
-                    w, self._work_q, self._result_q, self._shm.name,
+                    w, self._work_qs[w], self._result_q, self._shm.name,
                     self._slot_bytes,
                     w * slots_per_worker * self._slot_bytes,
                     slots_per_worker, self._sems[w], params,
@@ -321,15 +358,31 @@ class MultiProcessIngester:
         for p in self._procs:
             p.start()
         self.metrics = metrics  # CollectorMetrics-shaped, optional
-        self.counters = {"accepted": 0, "sampleDropped": 0, "fallbacks": 0}
+        self.counters = {
+            "accepted": 0, "sampleDropped": 0, "fallbacks": 0, "rejected": 0,
+        }
         self._inflight = 0
         self._cv = threading.Condition()
         self._closed = False
         self._dispatch_error: Optional[BaseException] = None
+        # payload retention until APPLIED (zero-loss worker death):
+        # _pending maps payload id -> raw bytes, _assigned -> the worker
+        # that owns it, _buffered -> its not-yet-applied chunk results.
+        # _pending/_assigned are mutated by submit() (under _cv) and by
+        # the dispatcher thread; _buffered only by the dispatcher.
+        self._next_pid = 0
+        self._rr = 0
+        self._pending: Dict[int, bytes] = {}
+        self._assigned: Dict[int, int] = {}
+        self._buffered: Dict[int, list] = {}
+        self._dead: Set[int] = set()
+        self._maps: List[Optional[_IdMaps]] = [
+            _IdMaps() for _ in range(workers)
+        ]
         # reap reentrancy guard: _reap_dead_workers drains result_q via
         # _handle_msg, which can discover ANOTHER premature EOF — a
-        # recursive reap would abort the outer one before its work-queue
-        # salvage ran (ADVICE r4). Extra dead workers found mid-reap are
+        # recursive reap would abort the outer one before its salvage
+        # ran (ADVICE r4). Extra dead workers found mid-reap are
         # collected here and folded into the current reap instead.
         self._reaping = False
         self._reap_extra: List[int] = []
@@ -340,14 +393,68 @@ class MultiProcessIngester:
 
     # -- producer side ---------------------------------------------------
 
-    def submit(self, payload: bytes) -> None:
-        if self._closed:
-            raise RuntimeError("ingester closed")
-        if self._dispatch_error is not None:
-            raise RuntimeError("dispatcher died") from self._dispatch_error
-        with self._cv:
-            self._inflight += 1
-        self._work_q.put(payload)
+    def submit(self, payload: bytes, *, block: bool = True) -> None:
+        """Enqueue a payload onto one live worker's bounded queue.
+
+        Registration happens BEFORE the queue put (under _cv, the same
+        lock the reaper takes to mark workers dead), so a worker-death
+        reap is linearized against submission: either the reap sees the
+        registration and refeeds the payload, or submit() sees the
+        worker marked dead and picks another.
+        """
+        while True:
+            if self._closed:
+                raise RuntimeError("ingester closed")
+            if self._dispatch_error is not None:
+                raise RuntimeError(
+                    "dispatcher died"
+                ) from self._dispatch_error
+            with self._cv:
+                live = [
+                    w for w in range(self.workers) if w not in self._dead
+                ]
+                if not live:
+                    raise RuntimeError(
+                        "mp-ingest worker pool exhausted (every worker "
+                        "died); restart the ingester"
+                    )
+                start = self._rr % len(live)
+                self._rr += 1
+                pid = self._next_pid
+                self._next_pid += 1
+                self._pending[pid] = payload
+                self._inflight += 1
+            for w in live[start:] + live[:start]:
+                with self._cv:
+                    if w in self._dead:
+                        continue
+                    self._assigned[pid] = w
+                try:
+                    self._work_qs[w].put_nowait((pid, payload))
+                    return
+                except queue.Full:
+                    with self._cv:
+                        if pid not in self._pending:
+                            return  # a racing reap already refed it
+                        if self._assigned.get(pid) == w:
+                            self._assigned.pop(pid)
+            # every live queue is full: roll the registration back
+            with self._cv:
+                if pid not in self._pending:
+                    return  # a racing reap consumed it
+                self._pending.pop(pid)
+                self._assigned.pop(pid, None)
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._cv.notify_all()
+            if not block:
+                self.counters["rejected"] += 1
+                raise IngestBackpressure(
+                    f"every parse-worker queue is full "
+                    f"({len(live)} workers x depth {self.queue_depth}); "
+                    "retry after backoff"
+                )
+            time.sleep(0.002)
 
     def drain(self) -> None:
         """Block until every submitted payload has reached the device."""
@@ -362,33 +469,63 @@ class MultiProcessIngester:
         # device queue, not just the dispatch threads
         self.store.agg.block_until_ready()
 
+    def stats(self) -> dict:
+        """Fan-out tier gauges, merged into TpuStorage.ingest_counters()
+        so /metrics and /statusz show the tier."""
+        with self._cv:
+            inflight = self._inflight
+            dead = len(self._dead)
+        return {
+            "mpWorkers": self.workers,
+            "mpWorkersAlive": self.workers - dead,
+            "mpQueueDepth": self.queue_depth,
+            "mpInflight": inflight,
+            "mpAccepted": self.counters["accepted"],
+            "mpSampleDropped": self.counters["sampleDropped"],
+            "mpFallbacks": self.counters["fallbacks"],
+            "mpRejected": self.counters["rejected"],
+        }
+
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
-        for _ in self._procs:
-            # the work queue is bounded: with every worker dead (OOM
-            # storm) and the queue full of acked payloads, a plain
-            # put(None) would block forever. Only force space when
-            # nothing can be consuming — a slow-but-alive pool keeps
-            # its payloads.
+        for w, p in enumerate(self._procs):
+            if w in self._dead:
+                continue  # no consumer; nothing to shut down
+            # per-worker bounded queue: a live worker keeps consuming,
+            # so a timed put retried until it lands cannot hang; a
+            # worker that died mid-shutdown just stops needing one
             while True:
                 try:
-                    self._work_q.put(None, timeout=0.5)
+                    self._work_qs[w].put(None, timeout=0.5)
                     break
                 except queue.Full:
-                    if self._dispatch_error is not None or not any(
-                        p.is_alive() for p in self._procs
-                    ):
-                        try:
-                            self._work_q.get_nowait()
-                        except queue.Empty:
-                            pass
+                    if not p.is_alive():
+                        break
         for p in self._procs:
             p.join(timeout=30)
             if p.is_alive():  # pragma: no cover - hang safety
                 p.terminate()
         self._dispatcher.join(timeout=30)
+        for q in self._work_qs:
+            # a dead worker's queue may still hold (already-salvaged)
+            # payloads; don't let its feeder thread block interpreter
+            # exit flushing them to a pipe nobody reads
+            q.close()
+            q.cancel_join_thread()
+        if self._dispatch_error is not None:
+            # the stored exception's traceback pins the _handle_msg
+            # frame, whose locals include an ndarray VIEW into a shm
+            # slot — shm.close() would refuse ("exported pointers
+            # exist"). The dispatcher thread is joined, so the frames
+            # are safe to clear; drain()'s re-raise keeps the message.
+            import traceback
+
+            tb = self._dispatch_error.__traceback__
+            if tb is not None:
+                traceback.clear_frames(tb)
+        self._buffered.clear()
         self._shm.close()
         try:
             self._shm.unlink()
@@ -422,13 +559,10 @@ class MultiProcessIngester:
                 if self._closed and not any(p.is_alive() for p in self._procs):
                     return
                 continue
-            if msg[0] == _KIND_BATCH and msg[2] is not None:
+            if msg[0] == _KIND_BATCH and msg[3] is not None:
                 self._sems[msg[1]].release()
 
     def _run_dispatch(self) -> None:
-        import time
-
-        maps = [_IdMaps() for _ in range(self.workers)]
         eof_set: set = set()
         last_liveness = time.monotonic()
         while len(eof_set) < self.workers:
@@ -438,10 +572,10 @@ class MultiProcessIngester:
                 if self._closed and not any(p.is_alive() for p in self._procs):
                     break
                 if not self._closed:
-                    self._check_liveness(maps, eof_set)
+                    self._check_liveness(eof_set)
                     last_liveness = time.monotonic()
                 continue
-            self._handle_msg(msg, maps, eof_set)
+            self._handle_msg(msg, eof_set)
             # liveness must ALSO run under sustained traffic: a busy
             # surviving worker keeps result_q non-empty, so the idle
             # branch alone could leave a dead worker's acked payloads
@@ -450,10 +584,10 @@ class MultiProcessIngester:
                 not self._closed
                 and time.monotonic() - last_liveness > 2.0
             ):
-                self._check_liveness(maps, eof_set)
+                self._check_liveness(eof_set)
                 last_liveness = time.monotonic()
 
-    def _check_liveness(self, maps: List[_IdMaps], eof_set: set) -> None:
+    def _check_liveness(self, eof_set: set) -> None:
         """A worker that died uncleanly (segfault in the native parser,
         OOM kill) never sends EOF: without this check its inflight
         payloads would pin _inflight > 0 and drain()/stop() would wedge
@@ -464,72 +598,79 @@ class MultiProcessIngester:
             if not p.is_alive() and w not in eof_set
         ]
         if dead:
-            self._reap_dead_workers(dead, maps, eof_set)
+            self._reap_dead_workers(dead, eof_set)
 
-    def _reap_dead_workers(
-        self, dead: List[int], maps: List[_IdMaps], eof_set: set
-    ) -> None:
-        """A worker died without EOF. Recover what is recoverable, then
-        surface a dispatcher error: results it already produced are
-        applied, payloads still in the work queue re-dispatch on the
-        slow path, but the payload it was processing is unaccountable
-        (its chunk count is unknown), so drain() must raise rather than
-        guess. Runs at most once per dispatcher lifetime (it ends in
-        raise); further dead workers discovered while draining results
-        below are folded into THIS reap via _reap_extra, never a nested
-        reap that would abort the salvage pass (ADVICE r4)."""
+    def _reap_dead_workers(self, dead: List[int], eof_set: set) -> None:
+        """A worker died without EOF. Recover EVERYTHING and keep the
+        pool serving on the survivors: because chunk application is
+        buffered until a payload's completion marker, a half-processed
+        payload has mutated no store state — its buffered chunks are
+        discarded and the whole payload (plus everything queued behind
+        it) re-ingests on the slow path. Zero acked-span loss, no
+        double-ingest, and the dead worker's _IdMaps / inflight
+        accounting are released (the leak the r8 satellite named).
+        Re-entrancy: draining result_q below can discover ANOTHER
+        premature EOF — those fold into THIS reap via _reap_extra
+        rather than recursing (ADVICE r4)."""
         self._reaping = True
-        # timeout-based drains, not get_nowait(): mp.Queue puts go
-        # through a feeder thread, so a just-submitted payload can be
-        # in the pipe but not yet visible — get_nowait() would miss it
-        # and silently lose a 202-acked payload
-        while True:  # apply results already produced (any worker)
-            try:
-                msg = self._result_q.get(timeout=0.25)
-            except queue.Empty:
-                break
-            self._handle_msg(msg, maps, eof_set)
-        if self._reap_extra:
-            dead = dead + [w for w in self._reap_extra if w not in dead]
-            self._reap_extra = []
-        salvaged = 0
-        # stop salvaging the moment close() starts: its shutdown
-        # sentinels must reach the surviving workers, not this loop
-        while not self._closed:  # payloads no dead worker will pick up
-            try:
-                payload = self._work_q.get(timeout=0.25)
-            except queue.Empty:
-                break
-            if payload is None:
-                # a concurrent close() raced us: try to hand the
-                # sentinel back. put_nowait, never a blocking put — the
-                # queue may have refilled, and blocking here would
-                # deadlock shutdown. Dropping it on Full is safe by
-                # COUNTING, not by any re-put mechanism: close() puts N
-                # sentinels, this reap runs once per dispatcher lifetime
-                # (it ends in raise) so at most 1 sentinel is dropped,
-                # and >=1 worker is dead — N-1 sentinels still cover the
-                # <=N-1 survivors. If reaping ever becomes repeatable,
-                # this argument breaks and sentinels must be re-counted.
+        try:
+            # mark dead under _cv FIRST: submit() registers under the
+            # same lock, so after this no new payload can target these
+            # workers, and every already-registered one is visible to
+            # the refeed scan below
+            with self._cv:
+                self._dead.update(dead)
+            # timeout-based drains, not get_nowait(): mp.Queue puts go
+            # through a feeder thread, so a just-shipped result can be
+            # in the pipe but not yet visible — get_nowait() would miss
+            # chunks a surviving worker already produced
+            while True:  # apply results already produced (any worker)
                 try:
-                    self._work_q.put_nowait(payload)
-                except queue.Full:
-                    pass
-                break
-            self._fallback(payload)
-            self.counters["fallbacks"] += 1
-            self._done_one()
-            salvaged += 1
-        with self._cv:
-            unaccounted = self._inflight
-        raise RuntimeError(
-            f"mp-ingest worker(s) {dead} died uncleanly; "
-            f"{salvaged} queued payload(s) salvaged via the slow path, "
-            f"{unaccounted} acked payload(s) unaccounted (in-process at "
-            "failure or raced by surviving workers) — restart the ingester"
+                    msg = self._result_q.get(timeout=0.25)
+                except queue.Empty:
+                    break
+                self._handle_msg(msg, eof_set)
+            if self._reap_extra:
+                with self._cv:
+                    self._dead.update(self._reap_extra)
+                dead = dead + [w for w in self._reap_extra if w not in dead]
+                self._reap_extra = []
+            refed = 0
+            for w in dead:
+                eof_set.add(w)
+                self._maps[w] = None  # free the dead worker's id tables
+                # empty its queue so the feeder thread can't block
+                # shutdown; the payloads themselves re-ingest via the
+                # _assigned scan (they are all still in _pending)
+                while True:
+                    try:
+                        item = self._work_qs[w].get(timeout=0.25)
+                    except queue.Empty:
+                        break
+                    del item
+                with self._cv:
+                    owned = [
+                        p for p, a in self._assigned.items() if a == w
+                    ]
+                for pid in owned:
+                    self._buffered.pop(pid, None)
+                    payload = self._pending.get(pid)
+                    if payload is None:
+                        continue
+                    self._fallback(payload)
+                    self.counters["fallbacks"] += 1
+                    self._finish(pid)
+                    refed += 1
+        finally:
+            self._reaping = False
+        logger.warning(
+            "mp-ingest worker(s) %s died uncleanly; %d acked payload(s) "
+            "re-ingested via the slow path, pool continues on %d "
+            "survivor(s)",
+            dead, refed, self.workers - len(self._dead),
         )
 
-    def _handle_msg(self, msg, maps: List[_IdMaps], eof_set: set) -> None:
+    def _handle_msg(self, msg, eof_set: set) -> None:  # zt-dispatch-critical: single thread between N workers and the device
         store = self.store
         vocab = store.vocab
         kind = msg[0]
@@ -540,35 +681,47 @@ class MultiProcessIngester:
                 # before close() means the worker loop was torn down by
                 # a BaseException (KeyboardInterrupt, a failing
                 # work_q.get) with its inflight payloads unaccounted —
-                # without this, drain() would wedge with no error and
-                # the liveness check would skip it (it IS in eof_set)
+                # treat it exactly like an unclean death and refeed
                 if self._reaping:
-                    # already inside a reap's result drain: fold this
-                    # worker into the current reap instead of recursing
-                    # (a nested reap would abort the outer salvage pass)
                     self._reap_extra.append(msg[1])
                 else:
-                    self._reap_dead_workers([msg[1]], maps, eof_set)
+                    self._reap_dead_workers([msg[1]], eof_set)
             return
         if kind == _KIND_FALLBACK:
-            _, widx, payload = msg
+            _, widx, pid = msg
+            payload = self._pending.get(pid)
+            if payload is None:
+                return  # a reap already refed it
+            self._buffered.pop(pid, None)
             self._fallback(payload)
             self.counters["fallbacks"] += 1
-            self._done_one()
+            self._finish(pid)
             return
         (
-            _, widx, slot, shape, n_spans, n_dur, n_err, dropped,
+            _, widx, pid, slot, shape, n_spans, n_dur, n_err, dropped,
             svc_new, name_new, pairs_new, arch, ts_range, rec,
+            parse_s, pack_s, route_s,
         ) = msg
-        m = maps[widx]
+        if widx in self._dead or pid not in self._pending:
+            # late chunk from a reaped worker (its payload already
+            # re-ingested on the slow path): only the slot needs freeing
+            if slot is not None:
+                self._sems[widx].release()
+            return
+        m = self._maps[widx]
         if svc_new or name_new or pairs_new:
             with store._intern_lock:
+                # zt-lint: disable=ZT09 — journal replay is per NEWLY
+                # INTERNED STRING (bounded by vocab capacity, amortized
+                # zero per span), not per span
                 m.svc = _IdMaps._append(
                     m.svc, [vocab.services.intern(s) for s in svc_new]
                 )
+                # zt-lint: disable=ZT09 — per new string, as above
                 m.name = _IdMaps._append(
                     m.name, [vocab.span_names.intern(s) for s in name_new]
                 )
+                # zt-lint: disable=ZT09 — per new (svc, name) pair
                 m.key = _IdMaps._append(
                     m.key,
                     [
@@ -576,6 +729,15 @@ class MultiProcessIngester:
                         for sl, nl in pairs_new
                     ],
                 )
+        # worker-measured stage wall time: the workers can't touch the
+        # in-process flight recorder, so their parse/pack/route timings
+        # ride the batch message and are recorded here
+        if parse_s > 0.0:
+            obs.record("parse", parse_s)
+        if pack_s > 0.0:
+            obs.record("pack", pack_s)
+        if route_s > 0.0:
+            obs.record("route", route_s)
         if slot is not None:
             t0 = time.perf_counter()
             size = int(np.prod(shape))
@@ -586,27 +748,54 @@ class MultiProcessIngester:
             )
             fused = src.reshape(shape).copy()
             self._sems[widx].release()  # slot free the moment we copied
-            self._remap(fused, m)
-            if arch:
-                self._archive(arch)
-            if rec is not None and getattr(store, "_disk", None) is not None:
+            from zipkin_tpu.tpu.columnar import remap_fused
+
+            remap_fused(fused, m.svc, m.key)
+            if rec is not None:
                 # remap the record's svc/rsvc/name/key lanes local ->
-                # global (the journal above already covers every id this
-                # chunk references) and append to the disk archive, so
-                # MP-ingested traces are raw-archived exactly like the
-                # sync fast path's (VERDICT r4 order 2)
+                # global NOW (the journal above covers every id this
+                # chunk references; the maps may have grown by apply
+                # time); append is deferred to the completion flush
                 rec = list(rec)
                 rec[7] = m.svc[rec[7]]
                 rec[8] = m.svc[rec[8]]
                 rec[9] = m.name[rec[9]]
                 rec[10] = m.key[rec[10]]
                 rec = tuple(rec)
+            self._buffered.setdefault(pid, []).append(
+                (fused, n_spans, n_dur, n_err, ts_range, arch, rec,
+                 time.perf_counter() - t0)
+            )
+        # dropped == -1 marks a continuation chunk; the payload is
+        # applied atomically on its LAST chunk's message
+        if dropped >= 0:
+            self._flush_payload(pid, dropped)
+
+    def _flush_payload(self, pid: int, dropped: int) -> None:  # zt-dispatch-critical: applies a completed payload to the device + durability path
+        """Apply a completed payload's buffered chunks: RAM/disk archive,
+        then ingest_fused — whose dispatch side carries the WAL append
+        and sampling verdicts, preserving ack-after-durability exactly
+        like the serial path. Until this runs, the payload has mutated
+        nothing, which is what makes worker death recoverable."""
+        store = self.store
+        total = 0
+        t0 = time.perf_counter()
+        copy_s = 0.0
+        # zt-lint: disable=ZT09 — per CHUNK (max_batch-sized), not per
+        # span; all per-span work inside is vectorized
+        for fused, n_spans, n_dur, n_err, ts_range, arch, rec, c_s in (
+            self._buffered.pop(pid, ())
+        ):
+            copy_s += c_s
+            if arch:
+                self._archive(arch)
+            if rec is not None and getattr(store, "_disk", None) is not None:
                 # sampling gate: the fused sketch feed below always sees
                 # 100% of spans; only raw-archive retention is gated.
-                # Gating happens AFTER the local->global remap so the
-                # verdict's svc/rsvc indices address the published link
-                # table, and here (not in disk_append_record) so the
-                # sync fast path is not double-gated.
+                # Gating happens here (not in disk_append_record) so the
+                # sync fast path is not double-gated, and at flush time
+                # so verdicts see the same publish state as the serial
+                # path's dispatch-ordered gate.
                 sampler = store.agg.sampler
                 if sampler is not None:
                     rec = sampler.gate_record(rec)
@@ -616,43 +805,31 @@ class MultiProcessIngester:
                 fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
                 ts_range=ts_range,
             )
-            obs.record("mp_record", time.perf_counter() - t0)
-            self.counters["accepted"] += n_spans
+            total += n_spans
+        obs.record("mp_record", copy_s + (time.perf_counter() - t0))
+        self.counters["accepted"] += total
         self.counters["sampleDropped"] += max(dropped, 0)
         if self.metrics is not None:
-            self.metrics.increment_spans(n_spans + max(dropped, 0))
+            self.metrics.increment_spans(total + max(dropped, 0))
             if dropped > 0:
                 self.metrics.increment_spans_dropped(dropped)
-        # dropped == -1 marks a continuation chunk; inflight
-        # decrements once per payload, on its LAST chunk's message
-        if dropped >= 0:
-            self._done_one()
+        self._finish(pid)
 
-    def _done_one(self) -> None:
+    def _finish(self, pid: int) -> None:
         with self._cv:
+            self._pending.pop(pid, None)
+            self._assigned.pop(pid, None)
             self._inflight -= 1
             if self._inflight == 0:
                 self._cv.notify_all()
 
-    def _remap(self, fused: np.ndarray, m: _IdMaps) -> None:
-        """Worker-local ids -> global ids, in place on the packed image
-        (row 9 = svc<<16|rsvc, row 10 = key<<8|flags)."""
-        sr = fused[:, 9, :]
-        fused[:, 9, :] = (m.svc[sr >> 16] << np.uint32(16)) | m.svc[
-            sr & np.uint32(0xFFFF)
-        ]
-        kf = fused[:, 10, :]
-        fused[:, 10, :] = (m.key[kf >> 8] << np.uint32(8)) | (
-            kf & np.uint32(0xFF)
-        )
-
     def _archive(self, slices: List[bytes]) -> None:
-        from zipkin_tpu.model import json_v2
+        from zipkin_tpu.tpu.store import _decode_raw_span
 
         spans = []
         for raw in slices:
             try:
-                spans.append(json_v2.decode_one_span(raw))
+                spans.append(_decode_raw_span(raw))
             except Exception:  # slice the strict codec rejects: skip
                 continue
         if not spans:
@@ -672,12 +849,13 @@ class MultiProcessIngester:
             self.store._archive.accept(spans).execute()
 
     def _fallback(self, payload: bytes) -> None:
-        """Payloads the native parser rejects take the object path —
-        including the boundary sampler, so a parser punt cannot smuggle
-        unsampled spans into the store. Malformed payloads are counted
-        and dropped (the asynchronous-ack trade: like the reference's
-        Kafka collector, a poison message can't be HTTP-400'd after the
-        202 — SURVEY.md §3.3)."""
+        """Payloads the native parser rejects — or that a dead worker
+        owned — take the object path, including the boundary sampler, so
+        a parser punt cannot smuggle unsampled spans into the store.
+        Malformed payloads are counted and dropped (the asynchronous-ack
+        trade: like the reference's Kafka collector, a poison message
+        can't be HTTP-400'd after the 202 — SURVEY.md §3.3). The codec
+        sniffs the wire format, so proto3 payloads fall back too."""
         from zipkin_tpu.model import codec
 
         try:

@@ -223,6 +223,10 @@ class TpuStorage(
         # RAM sample at this rate then only backs autocompleteTags, or
         # everything when no disk archive is configured.
         self._fast_archive_every = fast_archive_sample
+        # optional attached MP fan-out tier (tpu/mp_ingest.py): the
+        # server sets this so ingest_counters() surfaces the tier's
+        # gauges and close() can tear a forgotten tier down
+        self.mp_ingester = None
         # interning id-space coherence: the C-side vocab (fast path) and
         # the Python vocab (object path) assign ids sequentially; any
         # operation that interns must hold this lock so the orders match.
@@ -1268,6 +1272,11 @@ class TpuStorage(
                 self.sampling_controller.counters()
                 if self.sampling_controller is not None
                 else {}
+            ),
+            # fan-out tier gauges (mpWorkersAlive / mpInflight /
+            # mpRejected ...): present only when the MP tier is attached
+            **(
+                self.mp_ingester.stats() if self.mp_ingester is not None else {}
             ),
         }
 
