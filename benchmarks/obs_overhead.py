@@ -13,6 +13,13 @@ Two identical legs run back to back (``TPU_OBS`` state flipped on the
 process-global recorder between them), plus the recorder's own
 microbenchmark (ns per ``record()`` against a scratch instance).
 
+ISSUE 9 adds a second A/B over the FULL observability plane: recorder +
+windowed telemetry ticker + SLO watchdog + device observatory on vs all
+off, same null-sink leg, same < 2% bar. The windows/SLO tiers read
+seqlock snapshots off the hot path by design — this leg is the proof.
+A small device-dispatching leg then reports the observatory's
+steady-state recompile count after warmup (acceptance: 0).
+
 Run from the repo root: ``python -m benchmarks.obs_overhead``
 (OBS_BENCH_SPANS, OBS_BENCH_PORT) or ``BENCH_MODE=obs python bench.py``.
 """
@@ -65,6 +72,40 @@ async def run() -> dict:
         obs.RECORDER.set_enabled(was_enabled)
 
     overhead_pct = (best["off"] - best["on"]) / best["off"] * 100.0
+
+    # -- full-plane A/B (ISSUE 9): windows ticker + SLO + observatory --
+    from zipkin_tpu.obs.device import OBSERVATORY
+
+    plane_best = {"on": 0.0, "off": 0.0}
+    dev_was = OBSERVATORY.enabled
+    try:
+        for _ in range(pairs):
+            for label, on in (("on", True), ("off", False)):
+                obs.RECORDER.set_enabled(on)
+                OBSERVATORY.set_enabled(on)
+                leg = await _run_leg(
+                    "null", "json", port + i, 0, payloads, batch, total,
+                    config_overrides={
+                        "obs_windows_enabled": on,
+                        "obs_slo_enabled": on,
+                        # 1 Hz ticker cost stays in the timed region
+                        "obs_windows_tick_s": 1.0,
+                    },
+                )
+                i += 1
+                plane_best[label] = max(
+                    plane_best[label], leg["spans_per_sec"]
+                )
+    finally:
+        obs.RECORDER.set_enabled(was_enabled)
+        OBSERVATORY.set_enabled(dev_was)
+    plane_pct = (plane_best["off"] - plane_best["on"]) \
+        / plane_best["off"] * 100.0
+
+    # -- steady-state recompile check: a leg that DOES dispatch device
+    # programs (the null sink never does), warmed, then counted
+    recompiles = await asyncio.to_thread(_steady_state_recompiles)
+
     return {
         "metric": "obs_recorder_overhead_pct",
         "value": round(overhead_pct, 3),
@@ -72,10 +113,45 @@ async def run() -> dict:
         "spans_per_sec_recorder_off": best["off"],
         "spans_per_sec_recorder_on": best["on"],
         "record_ns_each": round(obs.RECORDER.measure_overhead(), 1),
+        "full_plane_overhead_pct": round(plane_pct, 3),
+        "spans_per_sec_plane_off": plane_best["off"],
+        "spans_per_sec_plane_on": plane_best["on"],
+        "device_recompiles_steady_state": recompiles,
         "spans_per_leg": total,
         "pairs": pairs,
-        "target": "< 2% (ISSUE 6 acceptance)",
+        "target": "< 2% (ISSUE 6/9 acceptance); 0 steady recompiles",
     }
+
+
+def _steady_state_recompiles() -> int:
+    """Warm the device programs with one batch shape, zero the
+    observatory, then run a sustained ingest + query mix — any cache
+    growth after warmup is a runtime recompile (acceptance: 0)."""
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.obs.device import OBSERVATORY
+    from zipkin_tpu.tpu.state import AggConfig
+    from zipkin_tpu.tpu.store import TpuStorage
+
+    was = OBSERVATORY.enabled
+    OBSERVATORY.set_enabled(True)
+    try:
+        store = TpuStorage(
+            config=AggConfig(max_services=128, max_keys=512,
+                             hll_precision=10, digest_centroids=32,
+                             ring_capacity=1 << 14),
+            pad_to_multiple=256,
+        )
+        spans = lots_of_spans(4096, seed=11, services=8, span_names=12)
+        store.accept(spans[:1024]).execute()  # warmup: compiles here
+        store.latency_quantiles([0.5, 0.99])
+        OBSERVATORY.reset_counters()
+        for lo in range(1024, len(spans), 1024):
+            store.accept(spans[lo:lo + 1024]).execute()
+        store.latency_quantiles([0.5, 0.99])
+        store.trace_cardinalities()
+        return OBSERVATORY.totals()["recompiles"]
+    finally:
+        OBSERVATORY.set_enabled(was)
 
 
 def main() -> None:

@@ -171,8 +171,12 @@ def main() -> None:
     # this harness measures for the same calls — within log2-bucket
     # resolution (the reported bound is < 2x above the true value).
     from zipkin_tpu import obs
+    from zipkin_tpu.obs.windows import WindowedTelemetry
 
     obs.RECORDER.reset()  # quiesced: ingest finished, reads are serial
+    # windowed plane attached post-reset: its baseline is the zeroed
+    # recorder, so one tick after the loop captures the whole run
+    windows = WindowedTelemetry(obs.RECORDER, tick_s=1.0)
     end_ts_ms = hi_min * 60_000
     store_walls = []
     for _ in range(reps):
@@ -180,6 +184,7 @@ def main() -> None:
         t1 = time.perf_counter()
         store.get_dependencies(end_ts_ms, end_ts_ms).execute()
         store_walls.append((time.perf_counter() - t1) * 1e3)
+    windows.tick()
     rec_fresh = obs.RECORDER.snapshot().stage("query_fresh")
     wall_p50 = _stats(store_walls)["p50"]
     rec_p50 = rec_fresh.p50_us / 1e3
@@ -196,6 +201,22 @@ def main() -> None:
             rec_fresh.count >= reps and 0.25 * wall_p50 <= rec_p50 <= 1.25 * wall_p50
         ),
     }
+    # ISSUE 9: the WINDOWED p99 over a window covering the whole
+    # quiesced run must (a) agree exactly with the cumulative plane —
+    # the delta-merge oracle, same buckets, same walk — and (b) agree
+    # with the harness wall the same way the cumulative p50 does.
+    win_fresh = windows.window(3600.0).stage("query_fresh")
+    wall_p99 = round(sorted(store_walls)[
+        min(len(store_walls) - 1, int(0.99 * len(store_walls)))], 2)
+    win_p99 = win_fresh.p99_us / 1e3
+    recorder_report["windowed_query_fresh_p99_ms"] = round(win_p99, 3)
+    recorder_report["windowed_matches_cumulative"] = bool(
+        win_fresh.count == rec_fresh.count
+        and win_fresh.p99_us == rec_fresh.p99_us
+    )
+    recorder_report["windowed_agrees_with_wall"] = bool(
+        win_fresh.count >= reps and 0.25 * wall_p99 <= win_p99 <= 1.25 * wall_p99
+    )
 
     # -- legacy (3-pull) vs packed (1-pull) dependency-edge A/B ----------
     # The raw (pre-pack) program still compiles; pulling its three

@@ -175,7 +175,8 @@ def _storage_for(leg: str, batch: int):
 
 
 async def _run_leg(leg: str, fmt: str, port: int, workers: int, payloads,
-                   batch: int, total: int) -> dict:
+                   batch: int, total: int,
+                   config_overrides: dict = None) -> dict:
     from zipkin_tpu.server.app import ZipkinServer
     from zipkin_tpu.server.config import ServerConfig
 
@@ -185,6 +186,7 @@ async def _run_leg(leg: str, fmt: str, port: int, workers: int, payloads,
             port=port, host="127.0.0.1", storage_type="tpu",
             tpu_fast_ingest=True, tpu_mp_workers=workers,
             grpc_collector_enabled=(fmt == "grpc"), grpc_port=0,
+            **(config_overrides or {}),
         ),
         storage=storage,
     )

@@ -1192,8 +1192,150 @@ def config5() -> bool:
     return bool(ok)
 
 
+def config6() -> bool:
+    """SLO watchdog trip/clear probe (ISSUE 9): induce a real burn on
+    the query_fresh latency SLO through the production record site, and
+    assert the multi-window watchdog trips within one long window, shows
+    the alert gauge on /prometheus, then clears after recovery.
+
+    The burn is physical, not mocked: forced fresh dependency reads
+    (read cache invalidated each rep) run the real read path, and the
+    over-threshold latency stream is recorded through the same
+    ``obs.record("query_fresh", ...)`` call ``_cached_read`` uses — so
+    the whole chain recorder -> windowed delta rings -> burn-rate
+    evaluation -> alert gauges is the production chain. Windows are
+    shrunk via the server config knobs (tick 0.25 s, short 2 s / long
+    4 s) so both phases complete in seconds; the read path drives the
+    ticks exactly as an unstarted embedded server would.
+    """
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tests.fixtures import TRACE
+    from zipkin_tpu import obs
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+    from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    short_s, long_s = 2.0, 4.0
+
+    async def scenario() -> dict:
+        storage = TpuStorage(
+            config=AggConfig(max_services=64, max_keys=256,
+                             hll_precision=9, digest_centroids=32,
+                             ring_capacity=1 << 13),
+            num_devices=1,
+        )
+        # warm the read path BEFORE the server builds its windowed
+        # plane: the first fresh read pays the compile wall (seconds,
+        # honestly recorded as query_fresh), which would otherwise be a
+        # real — but uninteresting — burn. The windows baseline at
+        # construction excludes everything recorded before it.
+        storage.accept(TRACE).execute()
+        end_ts = max(s.timestamp for s in TRACE) // 1000 + 60_000
+        for _ in range(3):
+            storage.invalidate_read_cache()
+            storage.get_dependencies(end_ts, 86_400_000).execute()
+        server = ZipkinServer(
+            ServerConfig(
+                storage_type="tpu",
+                obs_windows_tick_s=0.25,
+                obs_slo_short_s=short_s, obs_slo_long_s=long_s,
+            ),
+            storage=storage,
+        )
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+
+        async def verdict():
+            body = await (await client.get("/api/v2/tpu/statusz")).json()
+            return next(v for v in body["slo"]["specs"]
+                        if v["name"] == "query_fresh_p99"), body["slo"]
+
+        try:
+            await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"})
+
+            # phase A — healthy: fast fresh reads, no alert
+            for _ in range(4):
+                storage.invalidate_read_cache()
+                await client.get(
+                    f"/api/v2/dependencies?endTs={end_ts}&lookback=86400000")
+                await asyncio.sleep(0.3)
+            v, _ = await verdict()
+            healthy = not v["alert"]
+
+            # phase B — burn: every fresh read's latency lands way over
+            # the 50 ms threshold (recorded through the production site)
+            burn_t0 = time.perf_counter()
+            tripped_after = None
+            while time.perf_counter() - burn_t0 < 3 * long_s:
+                storage.invalidate_read_cache()
+                await client.get(
+                    f"/api/v2/dependencies?endTs={end_ts}&lookback=86400000")
+                for _ in range(4):
+                    obs.record("query_fresh", 0.080)
+                v, _ = await verdict()
+                if v["alert"]:
+                    tripped_after = time.perf_counter() - burn_t0
+                    break
+                await asyncio.sleep(0.3)
+            text = await (await client.get("/prometheus")).text()
+            alert_on_prom = \
+                'zipkin_tpu_slo_alert{slo="query_fresh_p99"} 1' in text
+            burn_on_prom = bool(
+                [l for l in text.splitlines()
+                 if l.startswith('zipkin_tpu_slo_burn_rate{slo="query_fresh_p99"')
+                 and float(l.rsplit(" ", 1)[1]) >= 2.0])
+
+            # phase C — recovery: healthy traffic only; the burn ages
+            # out of the long window and the alert clears
+            rec_t0 = time.perf_counter()
+            cleared_after = None
+            while time.perf_counter() - rec_t0 < 4 * long_s:
+                storage.invalidate_read_cache()
+                await client.get(
+                    f"/api/v2/dependencies?endTs={end_ts}&lookback=86400000")
+                v, slo = await verdict()
+                if not v["alert"]:
+                    cleared_after = time.perf_counter() - rec_t0
+                    break
+                await asyncio.sleep(0.3)
+            return {
+                "healthy_baseline": healthy,
+                "tripped_after_s": tripped_after and round(tripped_after, 2),
+                "alert_on_prometheus": alert_on_prom,
+                "burn_rate_on_prometheus": burn_on_prom,
+                "cleared_after_s": cleared_after and round(cleared_after, 2),
+                "trips": slo["trips"], "clears": slo["clears"],
+            }
+        finally:
+            await client.close()
+            await server.stop()
+
+    r = asyncio.run(scenario())
+    ok = bool(
+        r["healthy_baseline"]
+        # trip must land within one evaluation (long) window of the
+        # burn becoming visible, with one tick+poll of slack
+        and r["tripped_after_s"] is not None
+        and r["tripped_after_s"] <= long_s + 1.0
+        and r["alert_on_prometheus"] and r["burn_rate_on_prometheus"]
+        and r["cleared_after_s"] is not None
+        and r["trips"] >= 1 and r["clears"] >= 1
+    )
+    _emit(config="config6", passed=ok, short_s=short_s, long_s=long_s,
+          threshold_ms=50.0, **r)
+    return ok
+
+
 ALL = {"config0": config0, "config1": config1, "config2": config2,
-       "config3": config3, "config4": config4, "config5": config5}
+       "config3": config3, "config4": config4, "config5": config5,
+       "config6": config6}
 
 
 def main() -> None:

@@ -612,6 +612,97 @@ def test_zt08_clean_host_side_taxonomy_record(tmp_path):
     assert rules(result) == []
 
 
+def test_zt08_flags_record_relayed_unknown_stage(tmp_path):
+    # the no-selfspan relay variant obeys the same closed taxonomy
+    assert_rule_owned(
+        tmp_path,
+        """
+        from zipkin_tpu import obs
+
+        def dispatch():
+            obs.record_relayed("warp_drive", 0.1)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_record_relayed_inside_jitted_def(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import record_relayed
+
+        @jax.jit
+        def kernel(x):
+            record_relayed("mp_parse", 0.1)
+            return x
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_windows_hook_reachable_from_traced_code(tmp_path):
+    # windows ring ticks are host-side lock-holding mutation
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs.windows import WINDOWS
+
+        def _note(x):
+            WINDOWS.tick_if_due()
+            return x
+
+        def kernel(x):
+            return _note(x)
+
+        run = jax.jit(kernel)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_observatory_hook_inside_jitted_def(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu import obs
+        from zipkin_tpu.obs.device import OBSERVATORY
+
+        @jax.jit
+        def kernel(x):
+            OBSERVATORY.observe(kernel, (x,), {}, False)
+            return x
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_clean_host_side_windows_device_hooks(tmp_path):
+    # wrapping programs / ticking windows from plain host code is the
+    # intended use — only traced reachability is the violation
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs.device import OBSERVATORY
+        from zipkin_tpu.obs.windows import WINDOWS
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def build():
+            fn = OBSERVATORY.wrap("spmd_step", kernel)
+            WINDOWS.tick_if_due()
+            return fn
+        """,
+    )
+    assert rules(result) == []
+
+
 def test_zt08_ignores_unrelated_record_methods(tmp_path):
     # a .record attribute on some other object is not the obs recorder
     result = lint(

@@ -159,6 +159,28 @@ class TestConfigAndSlowPath:
         rec.record("parse", base / 2e6)
         assert rec.slow_events() == []
 
+    def test_record_relayed_skips_slow_ring_and_hooks(self):
+        """The fan-out dispatcher relays worker-measured stage walls via
+        record_relayed: histograms/quantiles fill identically, but the
+        slow ring and self-span hook never fire — the dispatcher's B3
+        context is not the context that did the work."""
+        rec = StageRecorder(enabled=True)
+        rec.set_budget_scale(0.0)  # every nonzero duration is over
+        seen = []
+        rec.set_slow_hook(lambda ev: seen.append(ev["stage"]))
+        rec.record_relayed("parse", 0.010)
+        st = rec.snapshot().stage("parse")
+        assert st.count == 1
+        assert st.max_us == 10_000
+        assert rec.slow_events() == []
+        assert seen == []
+        rec.set_budget_scale(1.0)
+        # disabled recorder: relayed records are no-ops too
+        rec.set_enabled(False)
+        rec.record_relayed("parse", 0.010)
+        assert rec.snapshot().stage("parse").count == 1
+        rec.set_enabled(True)
+
     def test_overhead_self_measurement_isolated(self):
         rec = StageRecorder(enabled=True)
         ns = rec.measure_overhead(n=500)

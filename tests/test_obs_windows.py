@@ -1,0 +1,260 @@
+"""Windowed telemetry plane (ISSUE 9): exact-merge oracle, the coarse
+tier, counter rates, and reset/idle tolerance.
+
+The oracle property under test: because each tick stores an exact delta
+of monotonic histogram counters, merging the deltas of any covered tick
+range reproduces the from-scratch histogram of the same interval —
+identical bucket counts, sums, and therefore identical quantile reads.
+"""
+
+import pytest
+
+from zipkin_tpu.obs.recorder import NUM_BUCKETS, StageRecorder
+from zipkin_tpu.obs.stages import NUM_STAGES, STAGE_INDEX
+from zipkin_tpu.obs.windows import WindowedTelemetry
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make(recorder=None, source=None, **kw):
+    clock = FakeClock()
+    kw.setdefault("tick_s", 1.0)
+    w = WindowedTelemetry(
+        recorder or StageRecorder(), source, clock=clock, **kw
+    )
+    return w, clock
+
+
+def tick(w, clock):
+    clock.advance(w.tick_s)
+    assert w.tick(clock())
+
+
+# -- exact oracle against the cumulative plane ---------------------------
+
+
+def test_full_window_equals_cumulative_snapshot():
+    rec = StageRecorder()
+    w, clock = make(rec)
+    durs = [1e-6, 5e-6, 17e-6, 300e-6, 0.002, 0.02]
+    for i, d in enumerate(durs):
+        rec.record("query_fresh", d)
+        if i % 2:
+            rec.record("wal_append", d * 2)
+        tick(w, clock)
+    snap = rec.snapshot()
+    win = w.window(len(durs) * w.tick_s)
+    assert win.ticks == len(durs)
+    # bucket-exact: the merged deltas reproduce the cumulative histogram
+    assert win.counts == snap.counts
+    assert win.sums == snap.sums
+    for name in ("query_fresh", "wal_append"):
+        ws, cs = win.stage(name), snap.stage(name)
+        assert ws.count == cs.count
+        assert ws.p50_us == cs.p50_us
+        assert ws.p99_us == cs.p99_us
+
+
+def test_window_is_exact_over_recent_ticks_only():
+    rec = StageRecorder()
+    w, clock = make(rec)
+    # 3 old ticks of slow observations, then 4 recent fast ones
+    for _ in range(3):
+        rec.record("query_fresh", 0.050)
+        tick(w, clock)
+    for _ in range(4):
+        rec.record("query_fresh", 10e-6)
+        tick(w, clock)
+    recent = w.window(4 * w.tick_s).stage("query_fresh")
+    assert recent.count == 4
+    # only the fast observations are in the window: p99 <= 15us bucket edge
+    assert recent.p99_us <= 15
+    full = w.window(7 * w.tick_s).stage("query_fresh")
+    assert full.count == 7
+    assert full.p99_us > 1000
+
+
+def test_window_before_any_tick_is_empty():
+    w, _ = make()
+    win = w.window(60)
+    assert win.ticks == 0
+    assert win.total_count == 0
+    assert win.counter_deltas == {}
+
+
+# -- coarse tier ---------------------------------------------------------
+
+
+def test_coarse_tier_merges_block_aligned():
+    rec = StageRecorder()
+    w, clock = make(rec, slots=4, coarse_slots=8, coarse_factor=2)
+    # 10 ticks, one observation each: fine ring holds the last 4,
+    # completed coarse blocks hold the older ticks in pairs
+    for _ in range(10):
+        rec.record("query_fresh", 100e-6)
+        tick(w, clock)
+    snap = rec.snapshot()
+    win = w.window(10 * w.tick_s)
+    assert win.ticks == 10
+    assert win.counts == snap.counts
+    assert win.sums == snap.sums
+
+
+def test_coarse_tier_over_covers_to_block_boundary():
+    rec = StageRecorder()
+    w, clock = make(rec, slots=4, coarse_slots=8, coarse_factor=4)
+    for _ in range(9):
+        rec.record("query_fresh", 100e-6)
+        tick(w, clock)
+    # want=6 > fine availability (4): fine segment covers tick 8 (back
+    # to the last coarse boundary), then whole blocks of 4 — rounding
+    # up to 2 blocks over-covers to all 9 ticks (bounded by factor-1)
+    win = w.window(6 * w.tick_s)
+    assert win.ticks == 9
+    assert win.stage("query_fresh").count == 9
+    assert win.span_s == pytest.approx(9 * w.tick_s)
+
+
+def test_ring_sized_retention_drops_oldest():
+    rec = StageRecorder()
+    w, clock = make(rec, slots=4, coarse_slots=2, coarse_factor=2)
+    # retention: 4 fine + 2*2 coarse ticks; push 20 so old blocks fall off
+    for _ in range(20):
+        rec.record("query_fresh", 100e-6)
+        tick(w, clock)
+    win = w.window(100 * w.tick_s)
+    # at most fine(4) + coarse_slots(2)*factor(2) = 8 ticks survive
+    assert win.ticks <= 8
+    assert win.stage("query_fresh").count == win.ticks
+
+
+# -- counter rates -------------------------------------------------------
+
+
+def test_rates_from_counter_deltas():
+    vals = {"spans": 0.0, "mpRejected": 0.0}
+    rec = StageRecorder()
+    w, clock = make(rec, lambda: dict(vals))
+    for _ in range(5):
+        vals["spans"] += 300
+        vals["mpRejected"] += 2
+        tick(w, clock)
+    win = w.window(5 * w.tick_s)
+    assert win.counter_deltas["spans"] == pytest.approx(1500)
+    assert win.rate("spans") == pytest.approx(300.0)
+    assert win.rate("mpRejected") == pytest.approx(2.0)
+    # a 2-tick window sees only the newest two increments
+    assert w.window(2 * w.tick_s).rate("spans") == pytest.approx(300.0)
+
+
+def test_counter_source_filters_non_scalars():
+    w, clock = make(
+        None, lambda: {"spans": 7, "mpWorkerTable": [{"widx": 0}], "ok": True}
+    )
+    tick(w, clock)
+    cur = w.current_counters()
+    assert cur["spans"] == 7
+    assert "mpWorkerTable" not in cur
+
+
+# -- reset / idle tolerance ----------------------------------------------
+
+
+def test_recorder_reset_clears_rings_and_rebaselines():
+    rec = StageRecorder()
+    w, clock = make(rec)
+    for _ in range(3):
+        rec.record("query_fresh", 1e-3)
+        tick(w, clock)
+    rec.reset()
+    clock.advance(w.tick_s)
+    assert not w.tick(clock())  # negative delta -> ring clear
+    assert w.resets == 1
+    assert w.window(60).total_count == 0
+    # the plane keeps working against the fresh baseline
+    rec.record("query_fresh", 1e-3)
+    tick(w, clock)
+    assert w.window(60).stage("query_fresh").count == 1
+
+
+def test_tick_if_due_fills_idle_gap_with_empty_slots():
+    rec = StageRecorder()
+    w, clock = make(rec)
+    rec.record("query_fresh", 1e-3)
+    tick(w, clock)
+    # idle 5s, then one new observation arrives with the catch-up read
+    clock.advance(5 * w.tick_s)
+    rec.record("query_fresh", 1e-3)
+    assert w.tick_if_due(clock()) == 5
+    assert w.ticks == 6
+    short = w.window(3 * w.tick_s).stage("query_fresh")
+    assert short.count == 1  # gap ticks merged as empty deltas
+    assert w.window(10 * w.tick_s).stage("query_fresh").count == 2
+
+
+def test_tick_if_due_noop_within_tick_period():
+    w, clock = make()
+    tick(w, clock)
+    assert w.tick_if_due(clock() + 0.25 * w.tick_s) == 0
+    assert w.ticks == 1
+
+
+def test_tick_if_due_giant_gap_resets_rings():
+    rec = StageRecorder()
+    w, clock = make(rec, slots=4, coarse_slots=2, coarse_factor=2)
+    rec.record("query_fresh", 1e-3)
+    tick(w, clock)
+    clock.advance(1000 * w.tick_s)
+    w.tick_if_due(clock())
+    assert w.window(100 * w.tick_s).total_count == 0
+
+
+def test_disabled_plane_skips_ticks():
+    w, clock = make()
+    w.set_enabled(False)
+    clock.advance(w.tick_s)
+    assert not w.tick(clock())
+    assert w.tick_if_due(clock() + 10) == 0
+    assert w.ticks == 0
+
+
+# -- construction / status ----------------------------------------------
+
+
+def test_pre_existing_totals_stay_out_of_windows():
+    rec = StageRecorder()
+    rec.record("query_fresh", 1e-3)  # before the plane attaches
+    w, clock = make(rec)
+    tick(w, clock)
+    assert w.window(60).total_count == 0
+
+
+def test_fine_ring_must_cover_one_coarse_block():
+    with pytest.raises(ValueError):
+        WindowedTelemetry(StageRecorder(), slots=8, coarse_factor=16)
+
+
+def test_status_shape():
+    rec = StageRecorder()
+    vals = {"spans": 0.0}
+    w, clock = make(rec, lambda: dict(vals))
+    for _ in range(3):
+        vals["spans"] += 10
+        rec.record("query_fresh", 1e-3)
+        tick(w, clock)
+    body = w.status()
+    assert body["ticks"] == 3
+    assert body["resets"] == 0
+    lb = body["lookbacks"]["10s"]
+    assert lb["coveredS"] == pytest.approx(3.0)
+    assert lb["stages"]["query_fresh"]["count"] == 3
+    assert lb["rates"]["spansPerSec"] == pytest.approx(10.0)

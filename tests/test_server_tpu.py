@@ -186,6 +186,25 @@ class TestTpuServer:
                 assert body["counter.zipkin_collector.spans.http"] == len(
                     spans
                 )
+                # per-worker attribution (ISSUE 9 satellite): the
+                # dispatcher tallies land on /statusz and /prometheus
+                body = await (
+                    await client.get("/api/v2/tpu/statusz")
+                ).json()
+                workers = body["workers"]
+                assert [w["widx"] for w in workers] == [0]
+                assert workers[0]["alive"] is True
+                assert workers[0]["spans"] == len(spans)
+                assert workers[0]["chunks"] >= 1
+                assert workers[0]["parseUs"] > 0
+                text = await (await client.get("/prometheus")).text()
+                _assert_valid_prometheus(text)
+                assert (
+                    f'zipkin_tpu_mp_worker_spans_total{{worker="0"}} '
+                    f"{len(spans)}" in text
+                )
+                assert 'zipkin_tpu_mp_worker_chunks_total{worker="0"}' \
+                    in text
             finally:
                 await client.close()
                 await server.stop()  # drains + closes the MP tier
@@ -380,5 +399,142 @@ class TestFlightRecorder:
             from zipkin_tpu import obs
 
             assert obs.RECORDER.budget_scale == 1.0  # scale restored
+
+        asyncio.run(wrapper())
+
+
+# -- windowed telemetry / device observatory / SLO surfaces (ISSUE 9) ----
+
+
+class TestObservabilityPlane:
+    def test_statusz_windows_device_slo_sections(self):
+        async def scenario(client):
+            resp = await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 202
+            body = await (await client.get("/api/v2/tpu/statusz")).json()
+            # windows: the read path drives at least the first tick
+            win = body["windows"]
+            assert win["ticks"] >= 1
+            assert win["tickS"] == 1.0
+            assert win["resets"] == 0
+            assert set(win["lookbacks"]) == {"10s", "60s", "300s", "3600s"}
+            for lb in win["lookbacks"].values():
+                assert {"coveredS", "stages", "rates"} <= set(lb)
+            # device observatory: the ingest dispatched real programs
+            dev = body["device"]
+            assert dev["enabled"] is True
+            assert dev["totals"]["calls"] > 0
+            assert dev["totals"]["compiles"] > 0
+            spmd = [n for n in dev["programs"] if n.startswith("spmd_")]
+            assert spmd, "no wrapped spmd_* programs reported"
+            some = dev["programs"][spmd[0]]
+            assert some["calls"] >= 1
+            assert "transfers" in dev
+            # slo: every default spec evaluated, nothing burning at rest
+            slo = body["slo"]
+            names = {v["name"] for v in slo["specs"]}
+            assert {"ingest_wire_to_ack", "query_fresh_p99",
+                    "durability_wal_fsync", "backpressure_429"} <= names
+            for v in slo["specs"]:
+                assert v["alert"] is False, v
+                assert set(v["windows"]) == {"60s", "300s"}
+            assert slo["alerting"] is False
+
+        run(scenario)
+
+    def test_prometheus_slo_and_device_families(self):
+        async def scenario(client):
+            await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"},
+            )
+            text = await (await client.get("/prometheus")).text()
+            _assert_valid_prometheus(text)
+            assert "# TYPE zipkin_tpu_slo_alert gauge" in text
+            assert "# TYPE zipkin_tpu_slo_burn_rate gauge" in text
+            assert 'zipkin_tpu_slo_alert{slo="query_fresh_p99"} 0' in text
+            assert re.search(
+                r'zipkin_tpu_slo_burn_rate\{slo="ingest_wire_to_ack",'
+                r'window="60s"\} ', text)
+            # device observatory counters flow through ingest_counters
+            assert "zipkin_tpu_device_program_calls " in text
+            assert "zipkin_tpu_device_compiles " in text
+            assert "zipkin_tpu_device_recompiles " in text
+            assert "zipkin_tpu_host_transfer_bytes " in text
+
+        run(scenario)
+
+    def test_windows_p99_agrees_with_cumulative_plane(self):
+        """The windowed quantile read agrees with the cumulative
+        recorder when the window covers the whole run — same buckets,
+        same walk (the PR 6 agrees_with_wall shape, one level up)."""
+        async def scenario(client):
+            from zipkin_tpu import obs
+
+            spans = lots_of_spans(800, seed=3)
+            await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(spans),
+                headers={"Content-Type": "application/json"},
+            )
+            body = await (await client.get("/api/v2/tpu/statusz")).json()
+            win = body["windows"]["lookbacks"]["3600s"]["stages"]
+            cum = obs.RECORDER.snapshot()
+            for name in ("parse", "pack"):
+                if name not in win:
+                    continue
+                st = cum.stage(name)
+                assert win[name]["count"] <= st.count
+                if win[name]["count"] == st.count:
+                    assert win[name]["p99Us"] == st.p99_us
+
+        run(scenario)
+
+    def test_windows_disabled_by_config(self):
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=2)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    obs_windows_enabled=False,
+                ),
+                storage=storage,
+            )
+            assert server._obs_windows is None
+            assert server._obs_slo is None
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                body = await (
+                    await client.get("/api/v2/tpu/statusz")
+                ).json()
+                assert "windows" not in body
+                assert "slo" not in body
+                text = await (await client.get("/prometheus")).text()
+                assert "zipkin_tpu_slo_alert" not in text
+            finally:
+                await client.close()
+                await server.stop()
+
+        asyncio.run(wrapper())
+
+    def test_ticker_starts_and_stops_with_server(self):
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=2)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    port=0,
+                ),
+                storage=storage,
+            )
+            await server.start()
+            try:
+                assert server._obs_windows.ticker_running
+            finally:
+                await server.stop()
+            assert not server._obs_windows.ticker_running
 
         asyncio.run(wrapper())

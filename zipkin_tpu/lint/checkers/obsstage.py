@@ -24,7 +24,19 @@ Two shapes are flagged:
 
 Recognized record shapes: ``obs.record(...)``, ``RECORDER.record(...)``,
 ``obs.RECORDER.record(...)``, and a bare ``record(...)`` when the module
-imports it ``from zipkin_tpu.obs import record``.
+imports it ``from zipkin_tpu.obs import record``. ``record_relayed`` —
+the no-selfspan variant the fan-out dispatcher uses for worker-measured
+stages — is held to the same discipline (literal taxonomy stage, host
+code only).
+
+The windowed-telemetry and device-observatory hooks (ISSUE 9) are host
+instrumentation too: ``WINDOWS.tick()`` / ``tick_if_due()`` mutate ring
+state under locks, ``OBSERVATORY.wrap()`` / ``observe()`` time dispatch
+walls with ``perf_counter``. Inside a traced region each would burn in a
+trace-time constant or fail under tracing, so traced-reachability flags
+them alongside ``record`` (roots ``WINDOWS``/``OBSERVATORY``/
+``obs_device``, plus bare imports from ``zipkin_tpu.obs.windows`` /
+``zipkin_tpu.obs.device``).
 """
 
 from __future__ import annotations
@@ -37,7 +49,14 @@ from zipkin_tpu.obs.stages import STAGES
 
 _FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
+_RECORD_ATTRS = {"record", "record_relayed"}
 _RECORD_ROOTS = {"obs", "RECORDER"}
+# windows/device hooks: host-only for the same reason record is; flagged
+# by the traced-reach pass but exempt from stage-arg validation (they
+# take no stage)
+_HOOK_ATTRS = {"tick", "tick_if_due", "observe", "wrap"}
+_HOOK_ROOTS = {"obs", "WINDOWS", "OBSERVATORY", "obs_device"}
+_HOOK_MODULES = {"zipkin_tpu.obs.windows", "zipkin_tpu.obs.device"}
 _TRACE_NAMES = {"jit", "shard_map"}
 
 
@@ -85,35 +104,54 @@ class ObsStageDiscipline(Checker):
     def check(self, module: Module):
         if "zipkin_tpu" not in module.imported_roots:
             return
-        bare = self._bare_record_aliases(module)
+        bare, bare_hooks = self._bare_aliases(module)
         records = [
             node
             for node in ast.walk(module.tree)
             if self._is_record_call(node, bare)
         ]
-        if not records:
+        hooks = any(
+            self._is_hook_call(node, bare_hooks)
+            for node in ast.walk(module.tree)
+        )
+        if not records and not hooks:
             return
         yield from self._check_stage_args(module, records)
-        yield from self._check_traced_reach(module, bare)
+        yield from self._check_traced_reach(module, bare, bare_hooks)
 
-    # -- record-call recognition ------------------------------------------
+    # -- record/hook call recognition --------------------------------------
 
-    def _bare_record_aliases(self, module: Module) -> set:
-        names = set()
+    def _bare_aliases(self, module: Module):
+        """(record aliases, hook aliases) pulled in by bare imports."""
+        records, hooks = set(), set()
         for node in ast.walk(module.tree):
-            if isinstance(node, ast.ImportFrom) and node.module == "zipkin_tpu.obs":
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.module == "zipkin_tpu.obs":
                 for a in node.names:
-                    if a.name == "record":
-                        names.add(a.asname or a.name)
-        return names
+                    if a.name in _RECORD_ATTRS:
+                        records.add(a.asname or a.name)
+            elif node.module in _HOOK_MODULES:
+                for a in node.names:
+                    if a.name in _HOOK_ATTRS:
+                        hooks.add(a.asname or a.name)
+        return records, hooks
 
     def _is_record_call(self, node: ast.AST, bare: set) -> bool:
         if not isinstance(node, ast.Call):
             return False
         f = node.func
-        if isinstance(f, ast.Attribute) and f.attr == "record":
+        if isinstance(f, ast.Attribute) and f.attr in _RECORD_ATTRS:
             return _root_name(f) in _RECORD_ROOTS
         return isinstance(f, ast.Name) and f.id in bare
+
+    def _is_hook_call(self, node: ast.AST, bare_hooks: set) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _HOOK_ATTRS:
+            return _root_name(f) in _HOOK_ROOTS
+        return isinstance(f, ast.Name) and f.id in bare_hooks
 
     # -- shape 2: stage names come from the closed taxonomy ----------------
 
@@ -146,7 +184,7 @@ class ObsStageDiscipline(Checker):
 
     # -- shape 1: no recording inside device-traced code -------------------
 
-    def _check_traced_reach(self, module: Module, bare: set):
+    def _check_traced_reach(self, module: Module, bare: set, bare_hooks: set):
         if not module.imported_roots & {"jax", "jnp"}:
             return
         defs = {}
@@ -187,6 +225,16 @@ class ObsStageDiscipline(Checker):
                         f"obs.record inside device-traced {root}(){where} "
                         "— host-side instrumentation runs once at trace "
                         "time, then never again",
+                    )
+                elif self._is_hook_call(node, bare_hooks):
+                    where = "" if fn.name == root else f" (via {fn.name}())"
+                    yield self.found(
+                        module,
+                        node,
+                        f"obs windows/device hook inside device-traced "
+                        f"{root}(){where} — ring/registry mutation is host "
+                        "code; under tracing it burns in a trace-time "
+                        "constant",
                     )
 
 

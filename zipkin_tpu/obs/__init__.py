@@ -6,8 +6,14 @@ call ``obs.record(stage, dur_s)`` with a stage-name literal from
 and that no record call hides inside jit'd/device-traced code).
 Disable with ``TPU_OBS=0`` — every record becomes one predicate check.
 
-``selfspans`` is imported lazily by the server (it pulls in the span
-model); low-level modules importing ``obs`` pay only for the recorder.
+``record_relayed`` is the histogram-only sibling for stage walls
+measured elsewhere (worker processes) and relayed to the recording
+thread — no budget/self-span path, so relayed time is never B3-linked
+to the dispatcher's unrelated request context.
+
+``selfspans``, ``windows``, ``device`` and ``slo`` are imported lazily
+by the server (they pull in more machinery); low-level modules
+importing ``obs`` pay only for the recorder.
 """
 
 import os
@@ -33,3 +39,4 @@ RECORDER = StageRecorder(
 )
 
 record = RECORDER.record
+record_relayed = RECORDER.record_relayed

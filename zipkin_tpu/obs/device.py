@@ -1,0 +1,293 @@
+"""Device-program observatory: runtime visibility into jitted programs.
+
+The static lint (ZT03) proves no *avoidable* recompile triggers exist in
+the source; this is the dynamic complement. Every jitted/shard_map
+entrypoint (ingest step variants, rollup, the spmd_* read programs) is
+wrapped at build time in :func:`DeviceObservatory.wrap`, which captures:
+
+- **call count + per-call device wall** (dispatch-to-ready, host view);
+- **compile count + compile wall** via the jit cache-size delta: jax's
+  ``jitted._cache_size()`` grows once per distinct input-shape
+  signature, so ``after > before`` around a call means that call paid a
+  trace+compile — a *runtime recompile detector*. Steady state must
+  show zero growth after warmup;
+- **``cost_analysis()`` / ``memory_analysis()`` at first compile**,
+  captured best-effort through an AOT ``lower().compile()`` of the same
+  arguments (one extra compile per program per process; disable with
+  ``TPU_OBS_DEVICE_ANALYSIS=0`` where compiles are expensive). The AOT
+  path does not populate the jit dispatch cache, so it never perturbs
+  the recompile detector;
+- **live-HBM and host-transfer gauges**: accelerator
+  ``memory_stats()`` (absent on CPU) and the readpack transfer
+  count/bytes, surfaced next to the existing ``hostTransfers`` counter.
+
+Counter updates are plain attribute writes: device dispatches are
+serialized under the aggregator lock, and these are debug gauges — a
+rare torn increment from an exotic caller skews a count, nothing more.
+The registry is process-global and name-keyed; ``_compiled_programs``
+is lru-cached per (config, mesh), so one name may accumulate several
+entries over a test run — reads merge them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ProgramStats:
+    """Counters for one wrapped program build (one jit'd callable)."""
+
+    __slots__ = ("name", "calls", "compiles", "call_wall_s",
+                 "compile_wall_s", "last_compile_s", "max_call_s",
+                 "cache_size", "cost", "memory", "analysis_wall_s",
+                 "_analysis_tried", "_cache_size_fn")
+
+    def __init__(self, name: str, fn: Callable) -> None:
+        self.name = name
+        self.calls = 0
+        self.compiles = 0
+        self.call_wall_s = 0.0
+        self.compile_wall_s = 0.0
+        self.last_compile_s = 0.0
+        self.max_call_s = 0.0
+        self.cache_size = 0
+        self.cost: Optional[Dict[str, float]] = None
+        self.memory: Optional[Dict[str, int]] = None
+        self.analysis_wall_s = 0.0
+        self._analysis_tried = False
+        # private jax API, probed once; absent -> no recompile detection
+        self._cache_size_fn = getattr(fn, "_cache_size", None)
+
+    @property
+    def recompiles(self) -> int:
+        """Compiles beyond the first: shape churn after warmup."""
+        return max(0, self.compiles - 1)
+
+    def observe(self, fn: Callable, args: tuple, kw: dict,
+                analysis: bool) -> Any:
+        size_fn = self._cache_size_fn
+        before = size_fn() if size_fn is not None else -1
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        dt = time.perf_counter() - t0
+        self.calls += 1
+        self.call_wall_s += dt
+        if dt > self.max_call_s:
+            self.max_call_s = dt
+        if size_fn is not None:
+            after = size_fn()
+            if after > before:
+                self.compiles += after - before
+                self.compile_wall_s += dt
+                self.last_compile_s = dt
+                self.cache_size = after
+                if analysis and not self._analysis_tried:
+                    self._capture_analysis(fn, args, kw)
+        return out
+
+    def _capture_analysis(self, fn: Callable, args: tuple,
+                          kw: dict) -> None:
+        self._analysis_tried = True
+        try:
+            t0 = time.perf_counter()
+            compiled = fn.lower(*args, **kw).compile()
+            self.analysis_wall_s = time.perf_counter() - t0
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if isinstance(ca, dict):
+                self.cost = {
+                    "flops": float(ca.get("flops", 0.0)),
+                    "bytesAccessed": float(ca.get("bytes accessed", 0.0)),
+                }
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                self.memory = {
+                    "generatedCodeBytes": int(getattr(
+                        ma, "generated_code_size_in_bytes", 0)),
+                    "argumentBytes": int(getattr(
+                        ma, "argument_size_in_bytes", 0)),
+                    "outputBytes": int(getattr(
+                        ma, "output_size_in_bytes", 0)),
+                    "tempBytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+                }
+        except Exception:
+            pass
+
+    def as_dict(self) -> Dict:
+        d: Dict = {
+            "calls": self.calls,
+            "compiles": self.compiles,
+            "recompiles": self.recompiles,
+            "callWallMs": round(self.call_wall_s * 1e3, 3),
+            "compileWallMs": round(self.compile_wall_s * 1e3, 3),
+            "lastCompileMs": round(self.last_compile_s * 1e3, 3),
+            "maxCallMs": round(self.max_call_s * 1e3, 3),
+        }
+        if self.cost is not None:
+            d["cost"] = self.cost
+        if self.memory is not None:
+            d["memory"] = self.memory
+        return d
+
+
+class DeviceObservatory:
+    """Process-global registry of wrapped device programs."""
+
+    def __init__(self, enabled: bool = True, analysis: bool = True) -> None:
+        self._enabled = bool(enabled)
+        self._analysis = bool(analysis)
+        self._lock = threading.Lock()
+        self._programs: Dict[str, List[ProgramStats]] = {}
+
+    def wrap(self, name: str, fn: Callable) -> Callable:
+        """Wrap one jitted callable; transparent when disabled."""
+        entry = ProgramStats(name, fn)
+        with self._lock:
+            self._programs.setdefault(name, []).append(entry)
+        obs = self
+
+        def wrapper(*args, **kw):
+            if not obs._enabled:
+                return fn(*args, **kw)
+            return entry.observe(fn, args, kw, obs._analysis)
+
+        wrapper.__name__ = name
+        wrapper.__wrapped__ = fn
+        wrapper.program_stats = entry
+        # AOT path stays reachable (benchmarks lower() programs directly)
+        lower = getattr(fn, "lower", None)
+        if lower is not None:
+            wrapper.lower = lower
+        return wrapper
+
+    # -- configuration -------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, on: bool) -> None:
+        self._enabled = bool(on)
+
+    def set_analysis(self, on: bool) -> None:
+        self._analysis = bool(on)
+
+    def reset_counters(self) -> None:
+        """Forget per-entry counters (bench A/B helper); keeps wraps."""
+        with self._lock:
+            entries = [e for lst in self._programs.values() for e in lst]
+        for e in entries:
+            e.calls = 0
+            e.compiles = 0
+            e.call_wall_s = 0.0
+            e.compile_wall_s = 0.0
+            e.last_compile_s = 0.0
+            e.max_call_s = 0.0
+
+    # -- query side ----------------------------------------------------
+
+    def totals(self) -> Dict[str, int]:
+        calls = compiles = recompiles = 0
+        with self._lock:
+            entries = [e for lst in self._programs.values() for e in lst]
+        for e in entries:
+            calls += e.calls
+            compiles += e.compiles
+            recompiles += e.recompiles
+        return {"programs": len(self._programs), "calls": calls,
+                "compiles": compiles, "recompiles": recompiles}
+
+    def programs(self) -> Dict[str, Dict]:
+        """Per-name merged view (several builds of one name sum up)."""
+        with self._lock:
+            items = {k: list(v) for k, v in self._programs.items()}
+        out: Dict[str, Dict] = {}
+        for name, entries in sorted(items.items()):
+            merged: Dict = {
+                "builds": len(entries), "calls": 0, "compiles": 0,
+                "recompiles": 0, "callWallMs": 0.0, "compileWallMs": 0.0,
+                "lastCompileMs": 0.0, "maxCallMs": 0.0,
+            }
+            for e in entries:
+                d = e.as_dict()
+                merged["calls"] += d["calls"]
+                merged["compiles"] += d["compiles"]
+                merged["recompiles"] += d["recompiles"]
+                merged["callWallMs"] = round(
+                    merged["callWallMs"] + d["callWallMs"], 3)
+                merged["compileWallMs"] = round(
+                    merged["compileWallMs"] + d["compileWallMs"], 3)
+                merged["lastCompileMs"] = max(
+                    merged["lastCompileMs"], d["lastCompileMs"])
+                merged["maxCallMs"] = max(merged["maxCallMs"], d["maxCallMs"])
+                if "cost" in d:
+                    merged["cost"] = d["cost"]
+                if "memory" in d:
+                    merged["memory"] = d["memory"]
+            out[name] = merged
+        return out
+
+    def status(self) -> Dict:
+        """Full dict for the ``/statusz`` device section."""
+        body = {
+            "enabled": self._enabled,
+            "analysis": self._analysis,
+            "totals": self.totals(),
+            "programs": self.programs(),
+            "hbm": hbm_stats(),
+        }
+        try:
+            from zipkin_tpu import readpack
+
+            body["transfers"] = {
+                "count": readpack.transfer_count(),
+                "bytes": readpack.transfer_bytes(),
+            }
+        except Exception:
+            pass
+        return body
+
+
+def hbm_stats() -> Dict:
+    """Live accelerator memory across local devices; ``{}`` where the
+    backend exposes no ``memory_stats()`` (CPU)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return {}
+    in_use = limit = peak = 0
+    seen = 0
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        seen += 1
+        in_use += int(stats.get("bytes_in_use", 0))
+        limit += int(stats.get("bytes_limit", 0))
+        peak += int(stats.get("peak_bytes_in_use", 0))
+    if not seen:
+        return {}
+    return {"devices": seen, "bytesInUse": in_use, "bytesLimit": limit,
+            "peakBytesInUse": peak}
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).strip().lower() \
+        not in ("0", "false", "no")
+
+
+OBSERVATORY = DeviceObservatory(
+    enabled=_env_on("TPU_OBS_DEVICE") and _env_on("TPU_OBS"),
+    analysis=_env_on("TPU_OBS_DEVICE_ANALYSIS"),
+)
+
+wrap = OBSERVATORY.wrap

@@ -191,6 +191,33 @@ class StageRecorder:
         if us > self._budgets_us[idx]:
             self._slow(stage, us, self._budgets_us[idx])
 
+    def record_relayed(self, stage: str, dur_s: float) -> None:
+        """Record a stage wall that was *measured on another thread or
+        process* and relayed here (e.g. worker parse/pack/route timings
+        riding MP batch messages). Histogram-only: no budget compare, no
+        slow ring, no self-span hook — the recording thread's request
+        context has nothing to do with where the time was spent, so a
+        budget crossing must not emit a self-span B3-linked to it."""
+        if not self._enabled:
+            return
+        idx = STAGE_INDEX[stage]
+        us = int(dur_s * 1_000_000 + 0.5)
+        if us < 0:
+            us = 0
+        b = us.bit_length()
+        if b >= NUM_BUCKETS:
+            b = NUM_BUCKETS - 1
+        try:
+            h = self._tl.hist
+        except AttributeError:
+            h = self._new_local()
+        h.gen += 1  # odd: local mid-update
+        h.counts[idx * NUM_BUCKETS + b] += 1
+        h.sums[idx] += us
+        if us > h.maxes[idx]:
+            h.maxes[idx] = us
+        h.gen += 1  # even: stable again
+
     def _new_local(self) -> _LocalHist:
         h = _LocalHist()
         with self._reg_lock:

@@ -1,0 +1,203 @@
+"""SLO burn-rate watchdog over the windowed telemetry plane.
+
+Declarative specs, Google-SRE-style multi-window evaluation: each
+:class:`SloSpec` names an error-budget objective and two lookbacks; the
+watchdog computes the **burn rate** (observed bad fraction divided by
+the budgeted bad fraction ``1 - objective``) over both windows and
+trips only when *both* burn — the short window gives fast reaction, the
+long window filters blips. A tripped alert holds until both windows
+recover (hysteresis for free: the long window keeps burning until the
+bad events age out of it).
+
+Spec grammar (three kinds):
+
+- ``latency``: ``stage`` + ``threshold_us`` against the windowed stage
+  histogram. An observation counts *bad* when its bucket's inclusive
+  upper bound exceeds the threshold — the same upper-bound convention
+  the quantile reads use, so "p99 < 50 ms" is expressed as objective
+  0.99 with threshold_us 50_000.
+- ``ratio``: ``bad`` counter delta over either ``total`` (exact
+  denominator) or ``bad + good`` (when no total counter exists).
+- ``gauge``: instantaneous counter value against ``limit``; burn is
+  ``value / limit`` on both windows and the alert threshold is 1.0
+  (a gauge is not rate-like, so the burn multiplier does not apply).
+
+Windows with no events do not burn: an idle system is in SLO.
+Evaluation is driven by the telemetry ticker (the watchdog subscribes
+to ``on_tick``) so trips land within one tick of the burn being
+visible; read paths may also call :meth:`SloWatchdog.evaluate`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from zipkin_tpu.obs.recorder import bucket_le_us
+from zipkin_tpu.obs.windows import WindowedTelemetry, WindowStats
+
+
+@dataclasses.dataclass(frozen=True)
+class SloSpec:
+    name: str
+    kind: str                  # "latency" | "ratio" | "gauge"
+    short_s: float = 60.0
+    long_s: float = 300.0
+    burn_threshold: float = 2.0
+    objective: float = 0.99    # good-fraction target (latency/ratio)
+    # latency
+    stage: str = ""
+    threshold_us: int = 0
+    # ratio
+    bad: str = ""
+    good: str = ""
+    total: str = ""
+    # gauge
+    gauge: str = ""
+    limit: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("latency", "ratio", "gauge"):
+            raise ValueError(f"unknown SLO kind: {self.kind!r}")
+        if self.kind == "latency" and not self.stage:
+            raise ValueError(f"{self.name}: latency SLO needs a stage")
+        if self.kind == "ratio" and not (self.bad and (self.good
+                                                       or self.total)):
+            raise ValueError(f"{self.name}: ratio SLO needs bad+good/total")
+        if self.kind == "gauge" and not (self.gauge and self.limit > 0):
+            raise ValueError(f"{self.name}: gauge SLO needs gauge+limit")
+
+
+def default_specs(short_s: float = 60.0, long_s: float = 300.0,
+                  burn_threshold: float = 2.0) -> List[SloSpec]:
+    """The four production SLOs from the north star, plus snapshot age."""
+    kw = dict(short_s=short_s, long_s=long_s, burn_threshold=burn_threshold)
+    return [
+        SloSpec("ingest_wire_to_ack", "ratio", objective=0.999,
+                bad="collectorMessagesDropped", total="collectorMessages",
+                **kw),
+        SloSpec("query_fresh_p99", "latency", objective=0.99,
+                stage="query_fresh", threshold_us=50_000, **kw),
+        SloSpec("durability_wal_fsync", "latency", objective=0.99,
+                stage="wal_fsync", threshold_us=100_000, **kw),
+        SloSpec("backpressure_429", "ratio", objective=0.99,
+                bad="mpRejected", good="mpAccepted", **kw),
+        SloSpec("snapshot_age", "gauge", gauge="snapshotAgeS",
+                limit=1800.0, **kw),
+    ]
+
+
+class SloWatchdog:
+    """Evaluates specs against a :class:`WindowedTelemetry` plane."""
+
+    def __init__(self, windows: WindowedTelemetry,
+                 specs: Optional[Sequence[SloSpec]] = None,
+                 subscribe: bool = True) -> None:
+        self._win = windows
+        self.specs: List[SloSpec] = list(specs if specs is not None
+                                         else default_specs())
+        self._lock = threading.Lock()
+        self._alerts: Dict[str, bool] = {s.name: False for s in self.specs}
+        self._verdicts: List[Dict] = []
+        self.trips = 0
+        self.clears = 0
+        if subscribe:
+            windows.on_tick(lambda _w: self.evaluate())
+
+    # -- burn math -----------------------------------------------------
+
+    @staticmethod
+    def _bad_fraction_latency(spec: SloSpec, w: WindowStats) -> tuple:
+        stat = w.stage(spec.stage)
+        if stat.count <= 0:
+            return 0.0, 0
+        bad = sum(c for b, c in enumerate(stat.buckets)
+                  if c and bucket_le_us(b) > spec.threshold_us)
+        return bad / stat.count, stat.count
+
+    @staticmethod
+    def _bad_fraction_ratio(spec: SloSpec, w: WindowStats) -> tuple:
+        deltas = w.counter_deltas
+        bad = max(0.0, deltas.get(spec.bad, 0.0))
+        if spec.total:
+            total = max(0.0, deltas.get(spec.total, 0.0))
+        else:
+            total = bad + max(0.0, deltas.get(spec.good, 0.0))
+        if total <= 0:
+            return 0.0, 0
+        return min(1.0, bad / total), int(total)
+
+    def _burn(self, spec: SloSpec, w: WindowStats) -> Dict:
+        if spec.kind == "gauge":
+            value = self._win.current_counters().get(spec.gauge, 0.0)
+            return {"burn": value / spec.limit, "events": 1,
+                    "value": value}
+        if spec.kind == "latency":
+            frac, events = self._bad_fraction_latency(spec, w)
+        else:
+            frac, events = self._bad_fraction_ratio(spec, w)
+        budget = max(1e-9, 1.0 - spec.objective)
+        return {"burn": frac / budget, "events": events,
+                "badFraction": round(frac, 6)}
+
+    # -- evaluation ----------------------------------------------------
+
+    def evaluate(self) -> List[Dict]:
+        """Evaluate every spec; returns (and caches) the verdict list."""
+        verdicts: List[Dict] = []
+        with self._lock:
+            for spec in self.specs:
+                short = self._burn(spec, self._win.window(spec.short_s))
+                long_ = self._burn(spec, self._win.window(spec.long_s))
+                thr = 1.0 if spec.kind == "gauge" else spec.burn_threshold
+                burning = short["burn"] >= thr and long_["burn"] >= thr
+                calm = short["burn"] < thr and long_["burn"] < thr
+                was = self._alerts[spec.name]
+                now = burning or (was and not calm)
+                if now and not was:
+                    self.trips += 1
+                elif was and not now:
+                    self.clears += 1
+                self._alerts[spec.name] = now
+                verdicts.append({
+                    "name": spec.name,
+                    "kind": spec.kind,
+                    "alert": now,
+                    "burnThreshold": thr,
+                    "objective": spec.objective,
+                    "windows": {
+                        f"{int(spec.short_s)}s": {
+                            **short, "burn": round(short["burn"], 4)},
+                        f"{int(spec.long_s)}s": {
+                            **long_, "burn": round(long_["burn"], 4)},
+                    },
+                })
+            self._verdicts = verdicts
+        return verdicts
+
+    def verdicts(self) -> List[Dict]:
+        """Latest cached verdicts (evaluates once if never run)."""
+        with self._lock:
+            cached = list(self._verdicts)
+        if cached:
+            return cached
+        return self.evaluate()
+
+    def alerts(self) -> Dict[str, bool]:
+        with self._lock:
+            return dict(self._alerts)
+
+    @property
+    def alerting(self) -> bool:
+        with self._lock:
+            return any(self._alerts.values())
+
+    def status(self) -> Dict:
+        """Full dict for the ``/statusz`` slo section."""
+        return {
+            "specs": self.verdicts(),
+            "alerting": self.alerting,
+            "trips": self.trips,
+            "clears": self.clears,
+        }

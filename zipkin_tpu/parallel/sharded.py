@@ -24,6 +24,7 @@ except ImportError:  # jax < 0.6 ships it under experimental
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zipkin_tpu import obs, readpack
+from zipkin_tpu.obs import device as obs_device
 from zipkin_tpu.ops import linker as dlink
 from zipkin_tpu.tpu import ingest as ing
 from zipkin_tpu.tpu.columnar import (
@@ -484,6 +485,34 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         "card": jax.jit(card_sm),
         "overview": jax.jit(overview_sm),
     }
+    # Device-program observatory (obs/device.py): every dispatchable
+    # program counts calls/compiles through a thin wrapper — the runtime
+    # recompile detector. The raw variants stay unwrapped (parity-test
+    # only, never dispatched in production).
+    _w = obs_device.OBSERVATORY.wrap
+    init = _w("spmd_init", init)
+    step_variants = {
+        k: _w("spmd_step" + ("_flush" if k[0] else "")
+              + ("_rollup" if k[1] else ""), v)
+        for k, v in step_variants.items()
+    }
+    links = _w("spmd_links", links)
+    merge = _w("spmd_merge", merge)
+    flush = _w("spmd_flush", flush)
+    rollup = _w("spmd_rollup", rollup)
+    whist = _w("spmd_whist", whist)
+    digest_read = _w("spmd_digest_read", digest_read)
+    edges = _w("spmd_edges", edges)
+    edges_fresh = _w("spmd_edges_fresh", edges_fresh)
+    edges_rolled = _w("spmd_edges_rolled", edges_rolled)
+    quant_digest = _w("spmd_quant_digest", quant_digest)
+    quant_digest_nopend = _w("spmd_quant_digest_nopend", quant_digest_nopend)
+    quant_hist = _w("spmd_quant_hist", quant_hist)
+    quant_whist = _w("spmd_quant_whist", quant_whist)
+    card = _w("spmd_card", card)
+    link_ctx = _w("spmd_link_ctx", link_ctx)
+    snap_copy = _w("spmd_snap_copy", snap_copy)
+    overview = _w("spmd_overview", overview)
     return (
         init, step_variants, links, merge, flush, rollup, whist, digest_read,
         edges, edges_fresh, edges_rolled, quant_digest, quant_digest_nopend,

@@ -73,14 +73,15 @@ CODE_DTYPES = {v: k for k, v in DTYPE_CODES.items()}
 
 _counter_lock = threading.Lock()
 _transfers = 0
+_transfer_bytes = 0
 
 
 def device_get(x) -> np.ndarray:
     """THE device→host pull for the query read path. Counts every call
-    so transfers-per-query is observable; everything that serves a query
-    must come through here (pinned by ZT-lint rule ZT01 via
-    tests/test_lint_clean.py)."""
-    global _transfers
+    (and its byte volume) so transfers-per-query is observable;
+    everything that serves a query must come through here (pinned by
+    ZT-lint rule ZT01 via tests/test_lint_clean.py)."""
+    global _transfers, _transfer_bytes
     with _counter_lock:
         _transfers += 1
     import jax
@@ -88,6 +89,8 @@ def device_get(x) -> np.ndarray:
     t0 = time.perf_counter()
     out = np.asarray(jax.device_get(x))
     obs.record("readpack_transfer", time.perf_counter() - t0)
+    with _counter_lock:
+        _transfer_bytes += out.nbytes
     return out
 
 
@@ -95,6 +98,12 @@ def transfer_count() -> int:
     """Process-wide device→host transfer count (monotonic)."""
     with _counter_lock:
         return _transfers
+
+
+def transfer_bytes() -> int:
+    """Process-wide device→host transfer volume in bytes (monotonic)."""
+    with _counter_lock:
+        return _transfer_bytes
 
 
 # -- device-side pack ----------------------------------------------------

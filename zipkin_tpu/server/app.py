@@ -197,6 +197,31 @@ class ZipkinServer:
                 budget_scale=self.config.obs_budget_scale,
             )
             self._obs_emitter.install(obs.RECORDER)
+        # windowed telemetry plane + SLO watchdog (ISSUE 9): per-tick
+        # delta rings over the recorder/counters, burn-rate evaluation
+        # on every tick. The ticker thread follows start()/stop();
+        # read paths catch up lazily so un-started embedders work too.
+        self._obs_windows = None
+        self._obs_slo = None
+        if self.config.obs_windows_enabled:
+            from zipkin_tpu.obs.windows import WindowedTelemetry
+
+            self._obs_windows = WindowedTelemetry(
+                obs.RECORDER,
+                self._window_counter_source,
+                tick_s=self.config.obs_windows_tick_s,
+            )
+            if self.config.obs_slo_enabled:
+                from zipkin_tpu.obs.slo import SloWatchdog, default_specs
+
+                self._obs_slo = SloWatchdog(
+                    self._obs_windows,
+                    default_specs(
+                        short_s=self.config.obs_slo_short_s,
+                        long_s=self.config.obs_slo_long_s,
+                        burn_threshold=self.config.obs_slo_burn_threshold,
+                    ),
+                )
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
         self._grpc = None
@@ -338,6 +363,8 @@ class ZipkinServer:
             self._snapshot_task = asyncio.create_task(
                 self._snapshot_loop(self.config.tpu_snapshot_interval_s)
             )
+        if self._obs_windows is not None:
+            self._obs_windows.start_ticker()
         logger.info("zipkin-tpu listening on :%d", self.config.port)
         return self
 
@@ -353,6 +380,10 @@ class ZipkinServer:
                 logger.exception("periodic snapshot failed; will retry")
 
     async def stop(self) -> None:
+        if self._obs_windows is not None:
+            # first: the ticker's counter source reads the storage,
+            # which teardown below closes
+            await asyncio.to_thread(self._obs_windows.stop_ticker)
         take_final_snapshot = self._snapshot_task is not None
         if self._snapshot_task is not None:
             self._snapshot_task.cancel()
@@ -675,6 +706,37 @@ class ZipkinServer:
             {"zipkin": {"version": zipkin_tpu.__version__, "flavor": "tpu"}}
         )
 
+    def _window_counter_source(self) -> dict:
+        """Counters the windowed plane samples each tick: transport-
+        summed collector tallies (the wire-to-ack SLO's numerators) plus
+        the storage tier's flat ingest counters."""
+        sums = {"messages": 0, "messages_dropped": 0,
+                "spans": 0, "spans_dropped": 0}
+        for key, value in self.metrics.snapshot().items():
+            _, _, name = key.partition(".")
+            if name in sums:
+                sums[name] += value
+        out = {
+            "collectorMessages": sums["messages"],
+            "collectorMessagesDropped": sums["messages_dropped"],
+            "collectorSpans": sums["spans"],
+            "collectorSpansDropped": sums["spans_dropped"],
+        }
+        if hasattr(self.storage, "ingest_counters"):
+            try:
+                out.update(self.storage.ingest_counters())
+            except Exception:
+                pass
+        return out
+
+    def _windows_catch_up(self) -> None:
+        """Read-path tick driver: keeps windows/SLO fresh on servers
+        that never ran start() (TestServer embedding). Blocking —
+        call via asyncio.to_thread."""
+        w = self._obs_windows
+        if w is not None and not w.ticker_running:
+            w.tick_if_due()
+
     async def get_metrics(self, request: web.Request) -> web.Response:
         """Actuator-style counters, reference taxonomy kept verbatim:
         ``counter.zipkin_collector.spans.http`` etc."""
@@ -735,6 +797,14 @@ class ZipkinServer:
             out[f"gauge.zipkin_tpu.stage.{st.stage}.p50Us"] = st.p50_us
             out[f"gauge.zipkin_tpu.stage.{st.stage}.p99Us"] = st.p99_us
             out[f"gauge.zipkin_tpu.stage.{st.stage}.maxUs"] = st.max_us
+        # SLO watchdog verdicts (ISSUE 9): alert flag + per-window burn
+        if self._obs_slo is not None:
+            await asyncio.to_thread(self._windows_catch_up)
+            for v in await asyncio.to_thread(self._obs_slo.verdicts):
+                base = f"gauge.zipkin_tpu.slo.{v['name']}"
+                out[f"{base}.alert"] = int(v["alert"])
+                for wname, wv in v["windows"].items():
+                    out[f"{base}.burn.{wname}"] = wv["burn"]
         return web.json_response(out)
 
     async def get_prometheus(self, request: web.Request) -> web.Response:
@@ -760,10 +830,15 @@ class ZipkinServer:
             # sampled_dropped / budget_utilization)
             counters = await asyncio.to_thread(self.storage.ingest_counters)
             for name, value in sorted(counters.items()):
+                if isinstance(value, bool) or not isinstance(
+                    value, (int, float)
+                ):
+                    continue  # nested tables (mpWorkerTable) ride /statusz
                 fam = _prom_name(f"zipkin_tpu_{_snake(name)}")
                 lines.append(f"# HELP {fam} Device-tier gauge {name}.")
                 lines.append(f"# TYPE {fam} gauge")
                 lines.append(f"{fam} {value}")
+            lines.extend(_prom_mp_workers(counters.get("mpWorkerTable")))
         if getattr(self.storage, "sampler", None) is not None:
             # live per-service keep probability (1.0 = keep everything)
             rates = await asyncio.to_thread(self.storage.sampler_rates)
@@ -778,6 +853,13 @@ class ZipkinServer:
                         f'zipkin_tpu_sampler_rate{{service="{_prom_label(svc)}"}} {rate}'
                     )
         lines.extend(_prom_stage_histograms(obs.RECORDER.snapshot()))
+        # SLO watchdog verdicts (ISSUE 9): boolean alert gauge (what pages)
+        # plus the per-window burn rates behind it (what to graph)
+        if self._obs_slo is not None:
+            await asyncio.to_thread(self._windows_catch_up)
+            lines.extend(
+                _prom_slo(await asyncio.to_thread(self._obs_slo.verdicts))
+            )
         return web.Response(text="\n".join(lines) + "\n")
 
     async def get_tpu_statusz(self, request: web.Request) -> web.Response:
@@ -829,6 +911,24 @@ class ZipkinServer:
         durability = await asyncio.to_thread(self._durability_status)
         if durability:
             body["durability"] = durability
+        # windowed telemetry plane + SLO verdicts (ISSUE 9)
+        if self._obs_windows is not None:
+            await asyncio.to_thread(self._windows_catch_up)
+            body["windows"] = await asyncio.to_thread(self._obs_windows.status)
+        if self._obs_slo is not None:
+            body["slo"] = await asyncio.to_thread(self._obs_slo.status)
+        # device-program observatory: compile counts, per-program device
+        # wall, first-compile cost/memory analysis, HBM + transfer gauges
+        from zipkin_tpu.obs.device import OBSERVATORY
+
+        body["device"] = await asyncio.to_thread(OBSERVATORY.status)
+        # per-worker attribution table from the fan-out tier (ISSUE 9
+        # satellite): dispatcher-side tallies keyed by widx
+        ing = getattr(self.storage, "mp_ingester", None)
+        if ing is not None:
+            stats = await asyncio.to_thread(ing.stats)
+            if "mpWorkerTable" in stats:
+                body["workers"] = stats["mpWorkerTable"]
         return web.json_response(body)
 
     def _durability_status(self) -> Optional[dict]:
@@ -941,6 +1041,61 @@ def _prom_stage_histograms(snap) -> List[str]:
         lines.append(f'{fam}_bucket{{stage="{st.stage}",le="+Inf"}} {st.count}')
         lines.append(f'{fam}_sum{{stage="{st.stage}"}} {st.sum_us / 1e6}')
         lines.append(f'{fam}_count{{stage="{st.stage}"}} {st.count}')
+    return lines
+
+
+def _prom_mp_workers(table) -> List[str]:
+    """Fan-out tier per-worker attribution as labelled counter families
+    (``worker="<widx>"``). The nested ``mpWorkerTable`` is skipped by the
+    flat-gauge loop; this is its exposition-format rendering."""
+    if not table:
+        return []
+    lines: List[str] = []
+    fields = (
+        ("chunks", "chunks dispatched"),
+        ("spans", "spans parsed"),
+        ("payloads", "payloads completed"),
+        ("parseUs", "parse wall microseconds"),
+        ("packUs", "pack wall microseconds"),
+        ("routeUs", "route wall microseconds"),
+        ("fallbacks", "inline-fallback payloads"),
+    )
+    for field, help_text in fields:
+        fam = _prom_name(f"zipkin_tpu_mp_worker_{_snake(field)}_total")
+        lines.append(f"# HELP {fam} Ingest worker {help_text}.")
+        lines.append(f"# TYPE {fam} counter")
+        for row in table:
+            lines.append(
+                f'{fam}{{worker="{_prom_label(row["widx"])}"}} {row[field]}'
+            )
+    return lines
+
+
+def _prom_slo(verdicts) -> List[str]:
+    """SLO watchdog families: one boolean alert gauge per SLO plus the
+    multi-window burn rates it was computed from."""
+    if not verdicts:
+        return []
+    alert_fam = "zipkin_tpu_slo_alert"
+    burn_fam = "zipkin_tpu_slo_burn_rate"
+    lines = [
+        f"# HELP {alert_fam} SLO burn-rate alert (1 = burning).",
+        f"# TYPE {alert_fam} gauge",
+    ]
+    for v in verdicts:
+        lines.append(
+            f'{alert_fam}{{slo="{_prom_label(v["name"])}"}} {int(v["alert"])}'
+        )
+    lines.append(
+        f"# HELP {burn_fam} Error-budget burn rate per evaluation window."
+    )
+    lines.append(f"# TYPE {burn_fam} gauge")
+    for v in verdicts:
+        for wname, wv in sorted(v["windows"].items()):
+            lines.append(
+                f'{burn_fam}{{slo="{_prom_label(v["name"])}",'
+                f'window="{_prom_label(wname)}"}} {wv["burn"]}'
+            )
     return lines
 
 

@@ -68,6 +68,20 @@ class ServerConfig:
     # stage budget (0.0 = everything is "slow"; dogfood/debug posture).
     obs_selfspans_enabled: bool = False
     obs_budget_scale: float = 1.0
+    # windowed telemetry plane (zipkin_tpu.obs.windows): per-tick delta
+    # rings over the flight recorder + store counters, serving windowed
+    # quantiles/rates on /statusz and feeding the SLO watchdog. The
+    # ticker thread runs with the server lifecycle; reads also catch up
+    # lazily, so embedders that never start() still get fresh windows.
+    obs_windows_enabled: bool = True
+    obs_windows_tick_s: float = 1.0
+    # SLO burn-rate watchdog (zipkin_tpu.obs.slo): multi-window burn
+    # evaluation of the default spec set; alerts ride /metrics,
+    # /prometheus and the statusz slo section
+    obs_slo_enabled: bool = True
+    obs_slo_short_s: float = 60.0
+    obs_slo_long_s: float = 300.0
+    obs_slo_burn_threshold: float = 2.0
     # TPU aggregation tier
     tpu_devices: Optional[int] = None  # None = all visible
     tpu_batch_size: int = 8192
@@ -179,6 +193,12 @@ class ServerConfig:
             self_tracing_sample_rate=_env_float("SELF_TRACING_SAMPLE_RATE", 1.0),
             obs_selfspans_enabled=_env_bool("TPU_OBS_SELFSPANS", False),
             obs_budget_scale=_env_float("TPU_OBS_BUDGET_SCALE", 1.0),
+            obs_windows_enabled=_env_bool("TPU_OBS_WINDOWS", True),
+            obs_windows_tick_s=_env_float("TPU_OBS_TICK_S", 1.0),
+            obs_slo_enabled=_env_bool("TPU_SLO", True),
+            obs_slo_short_s=_env_float("TPU_SLO_SHORT_S", 60.0),
+            obs_slo_long_s=_env_float("TPU_SLO_LONG_S", 300.0),
+            obs_slo_burn_threshold=_env_float("TPU_SLO_BURN", 2.0),
             tpu_devices=_env_int("TPU_DEVICES", 0) or None,
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_fast_ingest=fast_ingest,

@@ -78,6 +78,9 @@ class TpuStorage(_CoreTpuStorage):
         # (tpu/snapshot.py, ISSUE 7)
         self.snapshot_keep = max(1, int(snapshot_keep))
         self._snapshot_lock = threading.Lock()
+        # durability-lag gauge: age of the last persisted generation
+        # (boot counts as the epoch until the first snapshot lands)
+        self._last_snapshot_mono = time.monotonic()
         # boot restore/replay must not re-gate: WAL batches were compacted
         # to kept lanes at log time and replay restores the exact sampler
         # counters from record meta — a second verdict pass would re-drop
@@ -189,7 +192,18 @@ class TpuStorage(_CoreTpuStorage):
                 if covered is not None:
                     wal.truncate_covered(covered)
             obs.record("snapshot", time.perf_counter() - t0)
+            self._last_snapshot_mono = time.monotonic()
         return path
+
+    def ingest_counters(self) -> dict:
+        counters = super().ingest_counters()
+        if self.checkpoint_dir:
+            import time
+
+            counters["snapshotAgeS"] = round(
+                time.monotonic() - self._last_snapshot_mono, 3
+            )
+        return counters
 
     def close(self) -> None:
         # an attached MP fan-out tier (server sets .mp_ingester) must be

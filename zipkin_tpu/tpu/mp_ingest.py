@@ -361,6 +361,14 @@ class MultiProcessIngester:
         self.counters = {
             "accepted": 0, "sampleDropped": 0, "fallbacks": 0, "rejected": 0,
         }
+        # per-worker attribution (batch messages carry widx): a slow
+        # worker is distinguishable from a slow pool. Mutated only on
+        # the dispatcher thread; read lock-free by stats().
+        self._wstats = [
+            {"chunks": 0, "spans": 0, "payloads": 0, "parseUs": 0,
+             "packUs": 0, "routeUs": 0, "fallbacks": 0}
+            for _ in range(workers)
+        ]
         self._inflight = 0
         self._cv = threading.Condition()
         self._closed = False
@@ -484,6 +492,12 @@ class MultiProcessIngester:
             "mpSampleDropped": self.counters["sampleDropped"],
             "mpFallbacks": self.counters["fallbacks"],
             "mpRejected": self.counters["rejected"],
+            # nested per-worker table — scalar-only consumers
+            # (/prometheus gauge emission) skip non-scalar values
+            "mpWorkerTable": [
+                {"widx": w, "alive": w not in self._dead, **dict(ws)}
+                for w, ws in enumerate(self._wstats)
+            ],
         }
 
     def close(self) -> None:
@@ -695,6 +709,8 @@ class MultiProcessIngester:
             self._buffered.pop(pid, None)
             self._fallback(payload)
             self.counters["fallbacks"] += 1
+            if 0 <= widx < len(self._wstats):
+                self._wstats[widx]["fallbacks"] += 1
             self._finish(pid)
             return
         (
@@ -731,13 +747,24 @@ class MultiProcessIngester:
                 )
         # worker-measured stage wall time: the workers can't touch the
         # in-process flight recorder, so their parse/pack/route timings
-        # ride the batch message and are recorded here
+        # ride the batch message and are recorded here. record_relayed
+        # (histogram-only): the time was spent in a worker process, so a
+        # budget crossing must not emit a self-span B3-linked to
+        # whatever request context this dispatcher thread holds.
         if parse_s > 0.0:
-            obs.record("parse", parse_s)
+            obs.record_relayed("parse", parse_s)
         if pack_s > 0.0:
-            obs.record("pack", pack_s)
+            obs.record_relayed("pack", pack_s)
         if route_s > 0.0:
-            obs.record("route", route_s)
+            obs.record_relayed("route", route_s)
+        ws = self._wstats[widx]
+        ws["chunks"] += 1
+        ws["spans"] += n_spans
+        ws["parseUs"] += int(parse_s * 1e6 + 0.5)
+        ws["packUs"] += int(pack_s * 1e6 + 0.5)
+        ws["routeUs"] += int(route_s * 1e6 + 0.5)
+        if dropped >= 0:
+            ws["payloads"] += 1
         if slot is not None:
             t0 = time.perf_counter()
             size = int(np.prod(shape))

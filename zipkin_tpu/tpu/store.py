@@ -30,7 +30,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from zipkin_tpu import obs
+from zipkin_tpu import obs, readpack
 from zipkin_tpu.internal.hex import epoch_minutes
 from zipkin_tpu.ops import hll
 from zipkin_tpu.model.span import DependencyLink, Span
@@ -1231,6 +1231,9 @@ class TpuStorage(
         }
 
     def ingest_counters(self) -> dict:
+        from zipkin_tpu.obs.device import OBSERVATORY
+
+        _dev_totals = OBSERVATORY.totals()
         # host counters: exact and wrap-free (device counters are u32)
         return {
             **self.agg.host_counters,
@@ -1239,6 +1242,14 @@ class TpuStorage(
             "hostTransfers": self.agg.read_stats["host_transfers"],
             "rolledOnlyReads": self.agg.read_stats["rolled_only_reads"],
             "ctxReads": self.agg.read_stats["ctx_reads"],
+            # process-wide transfer volume through the readpack
+            # chokepoint, next to the per-store transfer count above
+            "hostTransferBytes": readpack.transfer_bytes(),
+            # device-program observatory aggregates (process-global):
+            # steady state must hold deviceRecompiles at 0 after warmup
+            "deviceProgramCalls": _dev_totals["calls"],
+            "deviceCompiles": _dev_totals["compiles"],
+            "deviceRecompiles": _dev_totals["recompiles"],
             # incremental link-ctx gauges (ISSUE 5): lanes the next
             # fresh read must delta-merge (bounded by rollup_segment),
             # ctx advances run, and the host wall of the last
