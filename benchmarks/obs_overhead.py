@@ -20,6 +20,11 @@ seqlock snapshots off the hot path by design — this leg is the proof.
 A small device-dispatching leg then reports the observatory's
 steady-state recompile count after warmup (acceptance: 0).
 
+ISSUE 10 adds a third A/B over the accuracy plane: the host-shadow tap
+on the FULL sink's fast dispatch path on vs off, same < 2% bar, plus
+the steady-state cost of one accuracy rollup (which runs off-path on
+the ticker thread at 5 s cadence).
+
 Run from the repo root: ``python -m benchmarks.obs_overhead``
 (OBS_BENCH_SPANS, OBS_BENCH_PORT) or ``BENCH_MODE=obs python bench.py``.
 """
@@ -102,6 +107,34 @@ async def run() -> dict:
     plane_pct = (plane_best["off"] - plane_best["on"]) \
         / plane_best["off"] * 100.0
 
+    # -- accuracy-plane A/B (ISSUE 10): shadow taps on vs off. The FULL
+    # sink this time — the shadow tap rides the fast dispatch path
+    # (offer_cols), so the null sink would never exercise it. Both
+    # sides keep the rest of the plane on; the delta isolates the tap.
+    # Rollups are pushed out of the timed region: they run at 5 s
+    # cadence on the ticker thread by design, and a short leg would
+    # time their one-off XLA read-program compile, not steady state —
+    # _shadow_rollup_cost_ms reports the steady per-rollup cost instead.
+    shadow_best = {"on": 0.0, "off": 0.0}
+    for _ in range(pairs):
+        for label, on in (("on", True), ("off", False)):
+            leg = await _run_leg(
+                "full", "json", port + i, 0, payloads, batch, total,
+                config_overrides={
+                    "obs_windows_enabled": True,
+                    "obs_windows_tick_s": 1.0,
+                    "obs_shadow_enabled": on,
+                    "obs_shadow_rollup_s": 1e9,
+                },
+            )
+            i += 1
+            shadow_best[label] = max(
+                shadow_best[label], leg["spans_per_sec"]
+            )
+    shadow_pct = (shadow_best["off"] - shadow_best["on"]) \
+        / shadow_best["off"] * 100.0
+    rollup_ms = await asyncio.to_thread(_shadow_rollup_cost_ms)
+
     # -- steady-state recompile check: a leg that DOES dispatch device
     # programs (the null sink never does), warmed, then counted
     recompiles = await asyncio.to_thread(_steady_state_recompiles)
@@ -116,11 +149,41 @@ async def run() -> dict:
         "full_plane_overhead_pct": round(plane_pct, 3),
         "spans_per_sec_plane_off": plane_best["off"],
         "spans_per_sec_plane_on": plane_best["on"],
+        "accuracy_plane_overhead_pct": round(shadow_pct, 3),
+        "spans_per_sec_shadow_off": shadow_best["off"],
+        "spans_per_sec_shadow_on": shadow_best["on"],
+        "accuracy_rollup_ms_steady": round(rollup_ms, 2),
         "device_recompiles_steady_state": recompiles,
         "spans_per_leg": total,
         "pairs": pairs,
         "target": "< 2% (ISSUE 6/9 acceptance); 0 steady recompiles",
     }
+
+
+def _shadow_rollup_cost_ms() -> float:
+    """Steady-state cost of one accuracy rollup (drain + three packed
+    device reads + linker-oracle replay), measured on the SECOND rollup
+    so the one-off read-program compile stays out of the number."""
+    from tests.fixtures import lots_of_spans
+    from zipkin_tpu.obs.accuracy import AccuracyEstimator
+    from zipkin_tpu.obs.shadow import HostShadow
+    from zipkin_tpu.tpu.state import AggConfig
+    from zipkin_tpu.tpu.store import TpuStorage
+
+    store = TpuStorage(
+        config=AggConfig(max_services=128, max_keys=512,
+                         hll_precision=10, digest_centroids=32,
+                         ring_capacity=1 << 14),
+        pad_to_multiple=256,
+    )
+    shadow = HostShadow()
+    est = AccuracyEstimator(store, shadow, rollup_s=0.0)
+    spans = lots_of_spans(8192, seed=13, services=16, span_names=24)
+    store.accept(spans).execute()
+    shadow.offer_spans(spans)
+    est.rollup()  # compiles the packed read programs
+    shadow.offer_spans(spans)
+    return est.rollup()["accuracyRollupMs"]
 
 
 def _steady_state_recompiles() -> int:

@@ -17,6 +17,12 @@ are set for a single box; raise with env vars for full-scale runs:
             boundary with sampling + WAL live; >=1M spans/s at >=2
             parse workers on a multi-core host, graceful measured
             degradation vs the same-run in-process budget on one core.
+  config6 — SLO watchdog trip/clear: induced query_fresh burn through
+            the production record site; alert within one long window,
+            visible on /prometheus, clears after recovery.
+  config7 — accuracy-drift trip/clear: undersized digest (C=4) on a
+            bimodal stream; the shadow-measured drift gauge crosses
+            0.20 and digest_p99_relerr trips, then clears after reset.
 
 Run: python -m evals.run_configs [config0 config1 ...]
 """
@@ -1333,9 +1339,173 @@ def config6() -> bool:
     return ok
 
 
+def config7() -> bool:
+    """Accuracy-drift trip/clear probe (ISSUE 10): run the device plane
+    with a deliberately undersized t-digest (C=4) and feed it a bimodal
+    duration stream it cannot summarize — the accuracy observatory's
+    shadow measures the real digest-vs-ground-truth p99 gap, the drift
+    gauge (excess over the shadow's own sampling noise) crosses the
+    0.20 SLO limit, and the digest_p99_relerr alert trips within one
+    long window. Recovery (state cleared, well-behaved unimodal stream)
+    clears it.
+
+    The drift is physical, not mocked: spans go through POST
+    /api/v2/spans, the shadow taps the production dispatch path, and
+    the rollup pulls the actual device digest through the packed read
+    chokepoint. The healthy phase proves the converse: the same C=4
+    digest on a narrow unimodal stream shows near-zero drift, so the
+    alert keys on genuine mis-sizing, not on the small digest per se.
+    """
+    import asyncio
+    import random
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    import numpy as np
+
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.model.span import Endpoint, Kind, Span
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+    from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    short_s, long_s = 2.0, 4.0
+    ep = Endpoint.create("checkout", "10.0.0.7")
+    seq = [0]
+
+    def make_spans(n, durs):
+        out = []
+        ts = int(time.time() * 1e6)
+        for d in durs[:n]:
+            seq[0] += 1
+            out.append(Span.create(
+                trace_id=f"{seq[0]:016x}", id=f"{seq[0]:016x}",
+                name="charge", kind=Kind.SERVER, local_endpoint=ep,
+                timestamp=ts + seq[0], duration=int(d),
+            ))
+        return out
+
+    rng = random.Random(23)
+    unimodal = lambda n: [rng.gauss(1000, 40) for _ in range(n)]
+    bimodal = lambda n: [
+        100_000 if rng.random() < 0.10 else 1000 for _ in range(n)
+    ]
+
+    async def scenario() -> dict:
+        storage = TpuStorage(
+            config=AggConfig(max_services=64, max_keys=256,
+                             hll_precision=9, digest_centroids=4,
+                             ring_capacity=1 << 13),
+            num_devices=1,
+        )
+        core = getattr(storage, "delegate", storage)
+        # warm the packed read programs BEFORE the server builds its
+        # windowed plane: the first rollup's compile wall (seconds)
+        # must not masquerade as phase-A time
+        storage.accept(make_spans(64, unimodal(64))).execute()
+        np.asarray(core.agg.merged_digest())
+        np.asarray(core.agg.cardinalities())
+        core.agg.dependency_edges(0, (1 << 32) - 1)
+        server = ZipkinServer(
+            ServerConfig(
+                storage_type="tpu",
+                obs_windows_tick_s=0.25,
+                obs_slo_short_s=short_s, obs_slo_long_s=long_s,
+                obs_shadow_rollup_s=0.0,  # roll up on every tick
+            ),
+            storage=storage,
+        )
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+
+        async def verdict():
+            body = await (await client.get("/api/v2/tpu/statusz")).json()
+            v = next(x for x in body["slo"]["specs"]
+                     if x["name"] == "digest_p99_relerr")
+            return v, body
+
+        async def post(spans):
+            resp = await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(spans),
+                headers={"Content-Type": "application/json"})
+            assert resp.status == 202
+
+        try:
+            # phase A — healthy: the undersized digest still summarizes
+            # a narrow unimodal stream fine; drift stays under the limit
+            await post(make_spans(2000, unimodal(2000)))
+            await asyncio.sleep(4 * 0.25)
+            v, body = await verdict()
+            healthy = not v["alert"]
+            healthy_drift = body["accuracy"]["gauges"][
+                "accuracyDigestP99Drift"]
+
+            # phase B — drift: bimodal stream the C=4 digest cannot
+            # hold; the observatory measures the gap against its exact
+            # reservoir and the drift gauge crosses the limit
+            await post(make_spans(4000, bimodal(4000)))
+            burn_t0 = time.perf_counter()
+            tripped_after = None
+            drift_seen = 0.0
+            while time.perf_counter() - burn_t0 < 3 * long_s:
+                v, body = await verdict()
+                drift_seen = max(drift_seen, body["accuracy"]["gauges"][
+                    "accuracyDigestP99Drift"])
+                if v["alert"]:
+                    tripped_after = time.perf_counter() - burn_t0
+                    break
+                await asyncio.sleep(0.2)
+            text = await (await client.get("/prometheus")).text()
+            alert_on_prom = \
+                'zipkin_tpu_slo_alert{slo="digest_p99_relerr"} 1' in text
+
+            # phase C — recovery: drop the poisoned state on both sides
+            # of the comparison, return to well-behaved traffic
+            core.clear()
+            server._obs_shadow.reset()
+            await post(make_spans(2000, unimodal(2000)))
+            rec_t0 = time.perf_counter()
+            cleared_after = None
+            while time.perf_counter() - rec_t0 < 4 * long_s:
+                v, body = await verdict()
+                if not v["alert"]:
+                    cleared_after = time.perf_counter() - rec_t0
+                    break
+                await asyncio.sleep(0.2)
+            return {
+                "healthy_baseline": healthy,
+                "healthy_drift": round(healthy_drift, 4),
+                "drift_seen": round(drift_seen, 4),
+                "tripped_after_s": tripped_after and round(tripped_after, 2),
+                "alert_on_prometheus": alert_on_prom,
+                "cleared_after_s": cleared_after and round(cleared_after, 2),
+                "trips": body["slo"]["trips"],
+                "clears": body["slo"]["clears"],
+            }
+        finally:
+            await client.close()
+            await server.stop()
+
+    r = asyncio.run(scenario())
+    ok = bool(
+        r["healthy_baseline"]
+        and r["healthy_drift"] < 0.20
+        and r["drift_seen"] > 0.20
+        and r["tripped_after_s"] is not None
+        and r["tripped_after_s"] <= long_s + 1.0
+        and r["alert_on_prometheus"]
+        and r["cleared_after_s"] is not None
+        and r["trips"] >= 1 and r["clears"] >= 1
+    )
+    _emit(config="config7", passed=ok, short_s=short_s, long_s=long_s,
+          drift_limit=0.20, digest_centroids=4, **r)
+    return ok
+
+
 ALL = {"config0": config0, "config1": config1, "config2": config2,
        "config3": config3, "config4": config4, "config5": config5,
-       "config6": config6}
+       "config6": config6, "config7": config7}
 
 
 def main() -> None:

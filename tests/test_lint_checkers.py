@@ -717,6 +717,104 @@ def test_zt08_ignores_unrelated_record_methods(tmp_path):
     assert rules(result) == []
 
 
+def test_zt08_flags_shadow_offer_inside_jitted_def(tmp_path):
+    # accuracy-shadow taps hold a host lock and touch numpy: never from
+    # traced code
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs.shadow import SHADOW
+
+        @jax.jit
+        def kernel(cols):
+            SHADOW.offer_cols(cols)
+            return cols
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_shadow_drain_reachable_from_traced_code(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs.shadow import drain
+
+        def _fold(x):
+            drain()
+            return x
+
+        def kernel(x):
+            return _fold(x)
+
+        run = jax.jit(kernel)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_accuracy_rollup_inside_shard_map(tmp_path):
+    # a rollup pulls device reads + replays the linker oracle: host only
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from zipkin_tpu.obs.accuracy import ACCURACY
+
+        def step(x):
+            ACCURACY.maybe_rollup()
+            return x
+
+        run = shard_map(step, mesh=None, in_specs=None, out_specs=None)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_clean_host_side_shadow_accuracy_hooks(tmp_path):
+    # offering lanes / draining / rolling up from plain host code is the
+    # intended use — only traced reachability is the violation
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs.shadow import SHADOW
+        from zipkin_tpu.obs.accuracy import ACCURACY
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def dispatch(cols):
+            SHADOW.offer_cols(cols)
+            SHADOW.drain()
+            ACCURACY.maybe_rollup()
+            return kernel(cols)
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt08_ignores_shadow_named_attribute_elsewhere(tmp_path):
+    # self.shadow.offer_cols on an arbitrary object is not the module
+    # hook — only the SHADOW/ACCURACY roots are recognized
+    result = lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def kernel(self, x):
+            self.shadow.offer_cols(x)
+            return x
+        """,
+    )
+    assert rules(result) == []
+
+
 # -- ZT09: dispatch-critical loops ---------------------------------------
 
 

@@ -200,5 +200,6 @@ class TestTaxonomy:
             "device_dispatch", "rollup", "ctx_advance", "wal_append",
             "wal_fsync", "snapshot", "sampler_tick", "archive_write",
             "query_fresh", "query_cached", "readpack_transfer", "mp_record",
+            "accuracy_rollup",
         }
         assert set(STAGES) == expected

@@ -1,0 +1,280 @@
+"""Accuracy observatory ground truth (ISSUE 10): every shadow estimator
+against a brute-force exact oracle over randomized span streams.
+
+The shadow's claims under test:
+
+- the per-service reservoir is a uniform k-sample — its quantiles land
+  inside the stated rank-noise interval around the exact stream
+  quantile (the reservoir-bias bound);
+- the adaptive distinct sketch is EXACT until saturation and its
+  estimate stays inside ``rel_bound`` of the true distinct count after;
+- link-trace sampling is trace-affine and complete: a sampled trace
+  retains every one of its spans, across batches and both lane taps;
+- the retention ledger reproduces the reference verdict tallies;
+- the fused-image tap decodes to the identical shadow state as the
+  columnar tap for the same lanes;
+- offers are bounded: overflow drops the OLDEST batch and counts it.
+"""
+
+import numpy as np
+
+from zipkin_tpu.obs.shadow import HostShadow, rank_interval
+from zipkin_tpu.tpu.columnar import SpanColumns, _hash2_np, fuse_columns
+
+
+def lanes(n, rng, services=4, with_parents=True):
+    """One randomized batch of span lanes as a SpanColumns."""
+    tl0 = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    tl1 = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    trace_h = _hash2_np(tl0, tl1)
+    s0 = rng.integers(1, 1 << 32, n, dtype=np.uint32)
+    p0 = np.where(
+        rng.random(n) < 0.5 if with_parents else np.zeros(n, bool),
+        rng.integers(1, 1 << 32, n, dtype=np.uint32),
+        np.uint32(0),
+    )
+    dur = rng.lognormal(7.0, 1.5, n).astype(np.uint32)
+    return SpanColumns(
+        trace_h=trace_h,
+        tl0=tl0,
+        tl1=tl1,
+        s0=s0,
+        s1=np.zeros(n, np.uint32),
+        p0=p0,
+        p1=np.zeros(n, np.uint32),
+        shared=rng.random(n) < 0.1,
+        kind=rng.integers(0, 5, n).astype(np.int32),
+        svc=rng.integers(1, services + 1, n).astype(np.int32),
+        rsvc=rng.integers(0, services + 1, n).astype(np.int32),
+        key=rng.integers(1, 16, n).astype(np.int32),
+        err=rng.random(n) < 0.05,
+        dur=dur,
+        has_dur=rng.random(n) < 0.9,
+        ts_min=np.zeros(n, np.uint32),
+        valid=rng.random(n) < 0.95,
+    )
+
+
+# -- reservoir: uniform-sample quantiles within the stated bound ---------
+
+
+def test_reservoir_quantiles_within_rank_bound():
+    rng = np.random.default_rng(11)
+    shadow = HostShadow(reservoir_k=512, seed=1)
+    exact = {}
+    for _ in range(20):
+        cols = lanes(2000, rng, services=3)
+        shadow.offer_cols(cols)
+        v = cols.valid & cols.has_dur
+        for s in np.unique(cols.svc[v]).tolist():
+            exact.setdefault(s, []).append(
+                cols.dur[v & (cols.svc == s)].astype(np.float64)
+            )
+    shadow.drain()
+    for s, chunks in exact.items():
+        stream = np.concatenate(chunks)
+        res = shadow.reservoir(s)
+        assert res is not None
+        assert res.seen == len(stream)
+        for q in (0.5, 0.9, 0.99):
+            # oracle bound: the reservoir's q-quantile must land between
+            # the exact stream quantiles at the z=4 rank interval (z=3
+            # per-check would give ~1% flake odds across 9 checks)
+            q_lo, q_hi = rank_interval(q, res.k, z=4.0)
+            lo, hi = np.quantile(stream, [q_lo, q_hi])
+            got = res.quantile(q)
+            assert lo <= got <= hi, (s, q, got, lo, hi)
+
+
+def test_reservoir_positional_uniformity():
+    """Algorithm R keeps a uniform sample: feed stream POSITIONS as the
+    values — every third of the stream must be equally represented in
+    the buffer (a biased vectorized fill skews old vs new). Positions
+    are light-tailed so the binomial band is exact, unlike a CLT band
+    on the heavy-tailed duration stream."""
+    from zipkin_tpu.obs.shadow import _Reservoir
+
+    k, total = 256, 30_000
+    hits = np.zeros(3)
+    for trial in range(50):
+        res = _Reservoir(k, np.random.default_rng(1000 + trial))
+        marks = np.arange(total, dtype=np.float64)
+        for chunk in np.array_split(marks, 40):  # uneven batch sizes OK
+            res.add(chunk)
+        assert res.seen == total
+        vals = res.values()
+        hits += np.histogram(vals, bins=[0, total / 3, 2 * total / 3, total])[0]
+    n = 50 * k
+    # each third holds 1/3 of the sample: 5-sigma binomial band
+    band = 5.0 * np.sqrt(n * (1 / 3) * (2 / 3))
+    assert np.all(np.abs(hits - n / 3) < band), hits
+
+
+# -- distinct sketch ------------------------------------------------------
+
+
+def test_distinct_exact_below_capacity():
+    rng = np.random.default_rng(3)
+    shadow = HostShadow(distinct_k=4096, seed=3)
+    seen = set()
+    for _ in range(5):
+        cols = lanes(500, rng)
+        shadow.offer_cols(cols)
+        v = cols.valid
+        ids = (cols.tl1[v].astype(np.uint64) << np.uint64(32)) | cols.tl0[v]
+        seen.update(int(x) for x in ids)
+    shadow.drain()
+    assert len(seen) <= 4096  # precondition: still exact
+    assert shadow.distinct_estimate() == len(seen)
+    assert shadow.distinct_bound() == 0.0
+
+
+def test_distinct_estimate_within_bound_after_saturation():
+    rng = np.random.default_rng(4)
+    shadow = HostShadow(distinct_k=1024, seed=4)
+    seen = set()
+    for _ in range(40):
+        cols = lanes(2000, rng)
+        shadow.offer_cols(cols)
+        v = cols.valid
+        ids = (cols.tl1[v].astype(np.uint64) << np.uint64(32)) | cols.tl0[v]
+        seen.update(int(x) for x in ids)
+    shadow.drain()
+    assert len(seen) > 1024  # saturated: θ has halved at least once
+    bound = shadow.distinct_bound()
+    assert 0.0 < bound < 1.0
+    rel = abs(shadow.distinct_estimate() - len(seen)) / len(seen)
+    assert rel <= bound, (rel, bound)
+
+
+# -- link-trace sampling: trace-affine and complete -----------------------
+
+
+def test_sampled_traces_are_complete_across_batches():
+    rng = np.random.default_rng(6)
+    shadow = HostShadow(link_rate=0.25, max_link_traces=4096,
+                        max_link_spans=4096, seed=6)
+    per_trace = {}
+    batches = [lanes(800, rng) for _ in range(4)]
+    # re-offer the SAME trace population in every batch: spans of one
+    # trace arriving in different batches must all land in its record
+    for cols in batches:
+        shadow.offer_cols(cols)
+        v = cols.valid
+        ids = (cols.tl1[v].astype(np.uint64) << np.uint64(32)) | cols.tl0[v]
+        for tid in ids.tolist():
+            per_trace[int(tid)] = per_trace.get(int(tid), 0) + 1
+    shadow.drain()
+    traces = shadow.link_traces()
+    assert traces, "0.25 of ~3000 traces should sample some"
+    for tid, recs in traces.items():
+        assert len(recs) == per_trace[tid], "sampled trace missing spans"
+
+
+def test_link_selection_is_deterministic():
+    """Same lanes -> same sampled trace set (pure hash selection, no
+    RNG): two shadows agree regardless of seed."""
+    rng = np.random.default_rng(7)
+    cols = lanes(2000, rng)
+    a = HostShadow(link_rate=0.2, seed=1)
+    b = HostShadow(link_rate=0.2, seed=999)
+    a.offer_cols(cols)
+    b.offer_cols(cols)
+    a.drain()
+    b.drain()
+    assert set(a.link_traces()) == set(b.link_traces())
+
+
+# -- fused tap decodes to the identical state -----------------------------
+
+
+def test_fused_and_cols_taps_agree():
+    rng = np.random.default_rng(8)
+    cols = lanes(1500, rng)
+    via_cols = HostShadow(seed=9)
+    via_fused = HostShadow(seed=9)
+    via_cols.offer_cols(cols)
+    via_fused.offer_fused(fuse_columns(cols))
+    via_cols.drain()
+    via_fused.drain()
+    assert via_cols.counters() == via_fused.counters()
+    assert via_cols.distinct_estimate() == via_fused.distinct_estimate()
+    assert via_cols.link_traces() == via_fused.link_traces()
+    assert via_cols.seen_by_service() == via_fused.seen_by_service()
+    for s in via_cols.services():
+        rc, rf = via_cols.reservoir(s), via_fused.reservoir(s)
+        # same seed + same fold order -> identical reservoir contents
+        assert np.array_equal(rc.values(), rf.values())
+
+
+# -- retention ledger vs the reference verdict ----------------------------
+
+
+def test_retention_tallies_match_host_verdict():
+    from zipkin_tpu.sampling.reference import HostSampler, host_verdict
+
+    sampler = HostSampler(max_services=64, max_keys=256)
+    # non-trivial tables: partial head rate, finite tail thresholds, and
+    # saturated links (rare clause off) so kept is a strict subset
+    sampler.rate = (sampler.rate // 8).astype(np.uint32)
+    sampler.tail = np.full_like(sampler.tail, 8000)
+    sampler.link = np.full_like(sampler.link, 1000)
+    rng = np.random.default_rng(10)
+    shadow = HostShadow(sampler_ref=lambda: sampler, seed=10)
+    cols = lanes(3000, rng, services=8)
+    shadow.offer_cols(cols)
+    shadow.drain()
+    v = cols.valid
+    expect = host_verdict(
+        cols.trace_h[v], cols.svc[v].astype(np.int64),
+        cols.rsvc[v].astype(np.int64), cols.key[v].astype(np.int64),
+        cols.dur[v], cols.has_dur[v], cols.err[v],
+        np.ones(int(v.sum()), bool),
+        sampler.rate, sampler.tail, sampler.link, sampler.rare_min,
+    )
+    seen, kept = shadow.retention()
+    assert seen == int(v.sum())
+    assert kept == int(expect.sum())
+
+
+# -- bounded memory / lifecycle -------------------------------------------
+
+
+def test_pending_overflow_drops_oldest_and_counts():
+    rng = np.random.default_rng(12)
+    shadow = HostShadow(pending_max=4, seed=12)
+    batches = [lanes(10, rng) for _ in range(10)]
+    for cols in batches:
+        shadow.offer_cols(cols)
+    assert shadow.dropped_batches == 6
+    assert shadow.counters()["shadowPending"] == 4
+    assert shadow.drain() == 4
+    # the 4 NEWEST batches survived
+    expect = sum(int(c.valid.sum()) for c in batches[-4:])
+    assert shadow.total_seen == expect
+
+
+def test_reset_clears_state_and_pending():
+    rng = np.random.default_rng(13)
+    shadow = HostShadow(seed=13)
+    shadow.offer_cols(lanes(500, rng))
+    shadow.drain()
+    shadow.offer_cols(lanes(500, rng))  # still pending
+    assert shadow.total_seen > 0
+    shadow.reset()
+    assert shadow.total_seen == 0
+    assert shadow.counters()["shadowPending"] == 0
+    assert shadow.distinct_estimate() == 0.0
+    assert shadow.link_traces() == {}
+    assert shadow.services() == []
+
+
+def test_invalid_lanes_are_ignored():
+    rng = np.random.default_rng(14)
+    cols = lanes(200, rng)
+    dead = cols._replace(valid=np.zeros(200, bool))
+    shadow = HostShadow(seed=14)
+    shadow.offer_cols(dead)
+    shadow.drain()
+    assert shadow.total_seen == 0
+    assert shadow.services() == []

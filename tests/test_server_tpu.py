@@ -216,16 +216,21 @@ class TestTpuServer:
 
 _PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _PROM_META = re.compile(rf"^# (HELP|TYPE) ({_PROM_NAME})(?: (.*))?$")
+_PROM_LABELS = r'(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*'
+# OpenMetrics exemplar suffix (ISSUE 10 satellite): ` # {labels} value`;
+# classic text-format parsers treat it as a trailing comment
+_PROM_EXEMPLAR = rf" # \{{({_PROM_LABELS})\}} (\S+)"
 _PROM_SAMPLE = re.compile(
-    rf"^({_PROM_NAME})(?:\{{((?:[a-zA-Z_][a-zA-Z0-9_]*="
-    rf'"(?:[^"\\]|\\.)*",?)*)\}})? (.+)$'
+    rf"^({_PROM_NAME})(?:\{{({_PROM_LABELS})\}})? (\S+)"
+    rf"(?:{_PROM_EXEMPLAR})?$"
 )
 
 
 def _assert_valid_prometheus(text):
     """Exposition-format validity: every line parses as metadata or a
-    sample, names stay inside the legal charset (no dots), every sample
-    belongs to a family that declared # HELP and # TYPE."""
+    sample (optionally exemplar-suffixed), names stay inside the legal
+    charset (no dots), every sample belongs to a family that declared
+    # HELP and # TYPE."""
     helped, typed = set(), {}
     samples = []
     for line in text.splitlines():
@@ -243,6 +248,9 @@ def _assert_valid_prometheus(text):
         assert m, f"unparsable exposition line: {line!r}"
         name, value = m.group(1), m.group(3)
         float(value)  # must parse
+        if m.group(5) is not None:
+            float(m.group(5))  # exemplar value must parse too
+            assert m.group(4), f"exemplar without labels: {line!r}"
         samples.append(name)
     assert samples, "empty exposition"
     for name in samples:
@@ -275,7 +283,7 @@ class TestFlightRecorder:
             for line in text.splitlines():
                 m = re.match(
                     rf'^{fam}_bucket\{{stage="([a-z_]+)",le="([^"]+)"\}} '
-                    rf"(\d+)$",
+                    rf"(\d+)(?: # .*)?$",
                     line,
                 )
                 if m:
@@ -536,5 +544,206 @@ class TestObservabilityPlane:
             finally:
                 await server.stop()
             assert not server._obs_windows.ticker_running
+
+        asyncio.run(wrapper())
+
+
+# -- accuracy observatory surfaces (ISSUE 10) -----------------------------
+
+
+class TestAccuracyObservatory:
+    def test_stage_histogram_exemplar_format(self):
+        """OpenMetrics exemplars: slow-ring events with a self-span
+        trace id attach to the matching log2 bucket line; events
+        without one (or for other buckets) leave lines bare. The whole
+        render must stay exposition-valid for classic parsers."""
+        from zipkin_tpu.obs.recorder import StageRecorder
+        from zipkin_tpu.server.app import _prom_stage_histograms
+
+        rec = StageRecorder()
+        rec.record("parse", 0.003)     # 3000us -> bucket 12
+        rec.record("parse", 0.0001)
+        rec.record("pack", 0.0002)
+        slow = [
+            {"stage": "parse", "durUs": 2100, "traceId": "feedc0de00000001"},
+            {"stage": "parse", "durUs": 3000, "traceId": "feedc0de00000002"},
+            {"stage": "pack", "durUs": 200},  # no trace id -> no exemplar
+        ]
+        text = "\n".join(_prom_stage_histograms(rec.snapshot(), slow))
+        _assert_valid_prometheus(text)
+        ex = [l for l in text.splitlines() if " # {" in l]
+        assert len(ex) == 1  # only the enriched parse bucket
+        m = re.match(
+            r'^zipkin_tpu_stage_latency_seconds_bucket'
+            r'\{stage="parse",le="0\.004095"\} \d+'
+            r' # \{trace_id="feedc0de00000002"\} 0\.003$',
+            ex[0],
+        )
+        assert m, ex[0]  # newest same-bucket event wins
+        # without the slow ring the render is exemplar-free
+        bare = "\n".join(_prom_stage_histograms(rec.snapshot()))
+        assert " # {" not in bare
+        _assert_valid_prometheus(bare)
+
+    def test_prometheus_exemplars_end_to_end(self):
+        """budget scale 0 + self-spans: the B3-linked slow events
+        surface as exemplars on /prometheus bucket lines."""
+        trace_id = "00000000000000cf"
+
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=2)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    self_tracing_enabled=True,
+                    obs_selfspans_enabled=True,
+                    obs_budget_scale=0.0,
+                ),
+                storage=storage,
+            )
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                    headers={
+                        "Content-Type": "application/json",
+                        "X-B3-TraceId": trace_id,
+                        "X-B3-SpanId": "00000000000000ab",
+                    },
+                )
+                assert resp.status == 202
+                # slow-ring enrichment lands after accept() returns: poll
+                ex = []
+                for _ in range(60):
+                    text = await (await client.get("/prometheus")).text()
+                    _assert_valid_prometheus(text)
+                    ex = [
+                        l for l in text.splitlines()
+                        if f'# {{trace_id="{trace_id}"}}' in l
+                    ]
+                    if ex:
+                        break
+                    await asyncio.sleep(0.05)
+                assert ex, "no exemplar carried the request's B3 link"
+                assert all(
+                    l.startswith("zipkin_tpu_stage_latency_seconds_bucket{")
+                    for l in ex
+                )
+            finally:
+                await client.close()
+                await server.stop()
+
+        asyncio.run(wrapper())
+
+    def test_accuracy_surfaces_end_to_end(self):
+        """Tentpole acceptance: ingest -> shadow -> rollup produces a
+        live accuracy report (statusz section, flat + per-service
+        prometheus families, /metrics gauges) with measured errors
+        inside the stated confidence bounds, and the drift SLOs stay
+        quiet on a healthy plane."""
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=8)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    obs_shadow_rollup_s=0.0,
+                ),
+                storage=storage,
+            )
+            assert server._accuracy is not None
+            assert server._obs_shadow is not None
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                spans = lots_of_spans(1500, seed=17, services=4,
+                                      span_names=6)
+                resp = await client.post(
+                    "/api/v2/spans", data=json_v2.encode_span_list(spans),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 202
+                gauges = await asyncio.to_thread(server._accuracy.rollup)
+                assert gauges["accuracyShadowCoverage"] == 1.0
+                assert gauges["accuracyRollups"] >= 1
+                # the live report: measured errors within stated bounds
+                assert (gauges["accuracyDigestP99RelErr"]
+                        <= gauges["accuracyDigestP99Bound"])
+                assert (gauges["accuracyHllRelErr"]
+                        <= gauges["accuracyHllBound"])
+                assert gauges["accuracyLinkRecall"] > 0.9
+                assert gauges["accuracyRetentionBias"] < 0.05
+                # a healthy plane shows no unexplained drift
+                assert gauges["accuracyDigestP99Drift"] == 0.0
+                assert gauges["accuracyHllDrift"] == 0.0
+
+                body = await (
+                    await client.get("/api/v2/tpu/statusz")
+                ).json()
+                acc = body["accuracy"]
+                assert acc["suppressed"] is False
+                assert acc["shadow"]["shadowSpans"] == len(spans)
+                assert len(acc["services"]) == 4
+                for row in acc["services"]:
+                    assert row["p99RelErr"] <= row["p99Bound"]
+                    assert row["reservoirSeen"] > 0
+                assert acc["links"]["shadowEdges"] >= 1
+                # drift SLOs evaluated, not burning
+                slo = {v["name"]: v for v in body["slo"]["specs"]}
+                assert slo["digest_p99_relerr"]["alert"] is False
+                assert slo["hll_relerr"]["alert"] is False
+                assert slo["hll_envelope"]["alert"] is False
+
+                text = await (await client.get("/prometheus")).text()
+                _assert_valid_prometheus(text)
+                assert "zipkin_tpu_accuracy_digest_p99_rel_err " in text
+                assert "zipkin_tpu_accuracy_digest_p99_drift " in text
+                assert "zipkin_tpu_accuracy_hll_rel_err " in text
+                assert "zipkin_tpu_accuracy_shadow_coverage 1.0" in text
+                assert "zipkin_tpu_shadow_spans " in text
+                assert re.search(
+                    r'zipkin_tpu_accuracy_service_p99_relerr'
+                    r'\{service="svc\d\d"\} ', text)
+                body = await (await client.get("/metrics")).json()
+                assert "gauge.zipkin_tpu.accuracyShadowCoverage" in body
+                assert "gauge.zipkin_tpu.shadowSpans" in body
+            finally:
+                await client.close()
+                await server.stop()
+
+        asyncio.run(wrapper())
+
+    def test_shadow_disabled_by_config(self):
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=2)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    obs_shadow_enabled=False,
+                ),
+                storage=storage,
+            )
+            assert server._accuracy is None
+            assert server._obs_shadow is None
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 202
+                body = await (
+                    await client.get("/api/v2/tpu/statusz")
+                ).json()
+                assert "accuracy" not in body
+                text = await (await client.get("/prometheus")).text()
+                assert "zipkin_tpu_accuracy_" not in text
+                # drift SLOs still evaluated (inert at gauge 0.0)
+                slo = {v["name"]: v for v in body["slo"]["specs"]}
+                assert slo["digest_p99_relerr"]["alert"] is False
+            finally:
+                await client.close()
+                await server.stop()
 
         asyncio.run(wrapper())

@@ -140,6 +140,7 @@ class Collector:
         metrics: Optional[CollectorMetrics] = None,
         fast_ingest: bool = False,
         mp_ingester=None,
+        shadow=None,
     ) -> None:
         self.storage = storage
         self.sampler = sampler or CollectorSampler(1.0)
@@ -152,6 +153,10 @@ class Collector:
         # are handed to worker processes and acked immediately — the
         # reference's 202-on-enqueue semantics (SURVEY.md §3.2)
         self.mp_ingester = mp_ingester
+        # accuracy-observatory tap (obs/shadow.py): the object path
+        # offers its post-sampling batches so the shadow sees the same
+        # stream the device plane aggregates. O(1) bounded append.
+        self.shadow = shadow
         self._consumer = storage.span_consumer()
 
     def accept_spans_bytes(
@@ -238,6 +243,8 @@ class Collector:
             self.metrics.increment_spans_dropped(dropped)
         if not sampled:
             return 0
+        if self.shadow is not None:
+            self.shadow.offer_spans(sampled)
         try:
             self._consumer.accept(sampled).execute()
         except Exception as e:

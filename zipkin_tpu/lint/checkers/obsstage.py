@@ -51,12 +51,24 @@ _FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 _RECORD_ATTRS = {"record", "record_relayed"}
 _RECORD_ROOTS = {"obs", "RECORDER"}
-# windows/device hooks: host-only for the same reason record is; flagged
-# by the traced-reach pass but exempt from stage-arg validation (they
-# take no stage)
-_HOOK_ATTRS = {"tick", "tick_if_due", "observe", "wrap"}
-_HOOK_ROOTS = {"obs", "WINDOWS", "OBSERVATORY", "obs_device"}
-_HOOK_MODULES = {"zipkin_tpu.obs.windows", "zipkin_tpu.obs.device"}
+# windows/device/shadow hooks: host-only for the same reason record is;
+# flagged by the traced-reach pass but exempt from stage-arg validation
+# (they take no stage). The accuracy-observatory hooks (ISSUE 10) join
+# the set: offer_* are bounded-deque appends and drain/rollup mutate
+# shadow state under locks — a traced region would capture one
+# trace-time batch forever (or fail under tracing).
+_HOOK_ATTRS = {
+    "tick", "tick_if_due", "observe", "wrap",
+    "offer_cols", "offer_fused", "offer_spans", "drain",
+    "rollup", "maybe_rollup",
+}
+_HOOK_ROOTS = {
+    "obs", "WINDOWS", "OBSERVATORY", "obs_device", "SHADOW", "ACCURACY",
+}
+_HOOK_MODULES = {
+    "zipkin_tpu.obs.windows", "zipkin_tpu.obs.device",
+    "zipkin_tpu.obs.shadow", "zipkin_tpu.obs.accuracy",
+}
 _TRACE_NAMES = {"jit", "shard_map"}
 
 

@@ -71,7 +71,22 @@ class SloSpec:
 
 def default_specs(short_s: float = 60.0, long_s: float = 300.0,
                   burn_threshold: float = 2.0) -> List[SloSpec]:
-    """The four production SLOs from the north star, plus snapshot age."""
+    """The four production SLOs from the north star, snapshot age, plus
+    the accuracy-drift gauges published by the accuracy observatory
+    (obs/accuracy.py) and the PR 2 HLL operating-envelope breach ratio.
+
+    The accuracy gauges default to 0.0 (and are coverage-gated to 0.0
+    when the shadow is lossy), so these specs are inert until a rollup
+    actually measures drift — an idle or shadowless deployment stays in
+    SLO. The specs watch the DRIFT gauges — relative error in excess
+    of the noise the accuracy plane's own ground truth carries (see
+    obs/accuracy.py) — not the raw relative errors: a heavy-tailed
+    stream makes the raw p99 comparison noisy even when the digest is
+    healthy, while an undersized digest shows up as drift the noise
+    bound cannot explain. Limits mirror the sketches' design envelopes
+    with headroom: t-digest C=64 claims ~0.5% p99 error, HLL p=14
+    claims ~0.8% — a sustained 20% / 15% of UNEXPLAINED relative error
+    means the structure is mis-sized or broken, not noisy."""
     kw = dict(short_s=short_s, long_s=long_s, burn_threshold=burn_threshold)
     return [
         SloSpec("ingest_wire_to_ack", "ratio", objective=0.999,
@@ -85,6 +100,12 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
                 bad="mpRejected", good="mpAccepted", **kw),
         SloSpec("snapshot_age", "gauge", gauge="snapshotAgeS",
                 limit=1800.0, **kw),
+        SloSpec("digest_p99_relerr", "gauge",
+                gauge="accuracyDigestP99Drift", limit=0.20, **kw),
+        SloSpec("hll_relerr", "gauge",
+                gauge="accuracyHllDrift", limit=0.15, **kw),
+        SloSpec("hll_envelope", "ratio", objective=0.99,
+                bad="hllEnvelopeExceeded", total="hostTransfers", **kw),
     ]
 
 

@@ -37,6 +37,7 @@ STAGES = (
     "query_cached",      # read-path cache hit under the version check
     "readpack_transfer",  # the single packed device→host pull per query
     "mp_record",         # MP dispatcher: shm copy + remap + device feed
+    "accuracy_rollup",   # shadow drain + device reads + error estimators
 )
 
 NUM_STAGES = len(STAGES)
@@ -61,6 +62,7 @@ DEFAULT_BUDGETS_US = {
     "query_cached": 50_000,
     "readpack_transfer": 100_000,
     "mp_record": 500_000,
+    "accuracy_rollup": 1_000_000,
 }
 
 assert set(DEFAULT_BUDGETS_US) == set(STAGES)

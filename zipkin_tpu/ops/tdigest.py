@@ -25,11 +25,25 @@ inside BASELINE config[1]'s epsilon.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from zipkin_tpu.ops.segments import sorted_segment_cumsum, sorted_segment_total
+
+
+def cluster_q_width(c: int, q: float) -> float:
+    """Width in q-space of the k1-scale cluster covering quantile ``q``
+    with ``c`` centroids: dq/dk = pi*sqrt(q(1-q))/c, plus a 1/(2c)
+    floor for the interpolation half-step near the extremes. This is
+    the digest's intrinsic rank resolution — the accuracy observatory
+    (obs/accuracy.py) converts it to a VALUE bound by evaluating the
+    ground-truth reservoir at ``q ± cluster_q_width``, which is what
+    makes the stated confidence bound distribution-free."""
+    return min(0.5, math.pi * math.sqrt(max(q * (1.0 - q), 0.0)) / c
+               + 0.5 / c)
 
 
 def new_digests(slots: int, centroids: int = 64) -> jnp.ndarray:

@@ -203,6 +203,8 @@ class ZipkinServer:
         # read paths catch up lazily so un-started embedders work too.
         self._obs_windows = None
         self._obs_slo = None
+        self._obs_shadow = None
+        self._accuracy = None
         if self.config.obs_windows_enabled:
             from zipkin_tpu.obs.windows import WindowedTelemetry
 
@@ -211,6 +213,48 @@ class ZipkinServer:
                 self._window_counter_source,
                 tick_s=self.config.obs_windows_tick_s,
             )
+            # accuracy observatory (ISSUE 10): bounded host shadow of the
+            # ingest stream + rollup-cadence relative-error estimators.
+            # TPU storage only (it audits the device sketch plane) and
+            # riding the windowed ticker; registered BEFORE the watchdog
+            # so each tick rolls up before burn evaluation (the gauges
+            # the watchdog reads are the tick's captured counters, so
+            # alerts lag at most one tick).
+            core = getattr(self.storage, "delegate", self.storage)
+            if (
+                self.config.obs_shadow_enabled
+                and hasattr(core, "agg")
+                and hasattr(core, "vocab")
+            ):
+                from zipkin_tpu.obs.accuracy import AccuracyEstimator
+                from zipkin_tpu.obs.shadow import HostShadow
+
+                self._obs_shadow = HostShadow(
+                    reservoir_k=self.config.obs_shadow_reservoir_k,
+                    distinct_k=self.config.obs_shadow_distinct_k,
+                    link_rate=self.config.obs_shadow_link_rate,
+                    pending_max=self.config.obs_shadow_pending_max,
+                    max_services=core.config.max_services,
+                    # deref the aggregator LAZILY: clear()/restore swap
+                    # it wholesale, and the shadow must follow
+                    sampler_ref=lambda: core.agg.sampler,
+                    # get, never intern: a read-side plane must not
+                    # perturb the id streams it audits
+                    svc_resolver=core.vocab.services.get,
+                )
+                self._accuracy = AccuracyEstimator(
+                    core,
+                    self._obs_shadow,
+                    rollup_s=self.config.obs_shadow_rollup_s,
+                )
+                core.shadow = self._obs_shadow
+                core.accuracy = self._accuracy
+                self.collector.shadow = self._obs_shadow
+                if self._mp_ingester is not None:
+                    self._mp_ingester.shadow = self._obs_shadow
+                self._obs_windows.on_tick(
+                    lambda _w: self._accuracy.maybe_rollup()
+                )
             if self.config.obs_slo_enabled:
                 from zipkin_tpu.obs.slo import SloWatchdog, default_specs
 
@@ -331,6 +375,7 @@ class ZipkinServer:
                     # fan-out as HTTP (ISSUE 8): proto3 is the tier's
                     # preferred wire, not the odd one out
                     mp_ingester=self._mp_ingester,
+                    shadow=self._obs_shadow,
                 ),
                 host=self.config.host,
                 port=self.config.grpc_port,
@@ -344,6 +389,7 @@ class ZipkinServer:
                     self.storage,
                     sampler=self.collector.sampler,
                     metrics=self.metrics.for_transport("scribe"),
+                    shadow=self._obs_shadow,
                 ),
                 host=self.config.host,
                 port=self.config.scribe_port,
@@ -792,6 +838,12 @@ class ZipkinServer:
             ):
                 if name in counters:
                     out[f"gauge.zipkin_tpu.{name}"] = counters[name]
+        # accuracy observatory (ISSUE 10): relative-error gauges from the
+        # latest rollup plus the shadow's own occupancy counters
+        if self._accuracy is not None:
+            acc = await asyncio.to_thread(self._accuracy.export_counters)
+            for name, value in sorted(acc.items()):
+                out[f"gauge.zipkin_tpu.{name}"] = value
         # pipeline flight recorder (zipkin_tpu.obs): per-stage quantiles
         for st in obs.RECORDER.snapshot().nonzero():
             out[f"gauge.zipkin_tpu.stage.{st.stage}.p50Us"] = st.p50_us
@@ -852,7 +904,18 @@ class ZipkinServer:
                     lines.append(
                         f'zipkin_tpu_sampler_rate{{service="{_prom_label(svc)}"}} {rate}'
                     )
-        lines.extend(_prom_stage_histograms(obs.RECORDER.snapshot()))
+        lines.extend(
+            _prom_stage_histograms(
+                obs.RECORDER.snapshot(), obs.RECORDER.slow_events()
+            )
+        )
+        # accuracy observatory (ISSUE 10): the flat zipkin_tpu_accuracy_*
+        # gauges already rode ingest_counters above; this adds the
+        # per-service digest-error family (labels need their own render)
+        if self._accuracy is not None:
+            lines.extend(
+                _prom_accuracy(await asyncio.to_thread(self._accuracy.status))
+            )
         # SLO watchdog verdicts (ISSUE 9): boolean alert gauge (what pages)
         # plus the per-window burn rates behind it (what to graph)
         if self._obs_slo is not None:
@@ -917,6 +980,10 @@ class ZipkinServer:
             body["windows"] = await asyncio.to_thread(self._obs_windows.status)
         if self._obs_slo is not None:
             body["slo"] = await asyncio.to_thread(self._obs_slo.status)
+        # accuracy observatory (ISSUE 10): the latest rollup's relative-
+        # error gauges, per-service digest detail, and shadow occupancy
+        if self._accuracy is not None:
+            body["accuracy"] = await asyncio.to_thread(self._accuracy.status)
         # device-program observatory: compile counts, per-program device
         # wall, first-compile cost/memory analysis, HBM + transfer gauges
         from zipkin_tpu.obs.device import OBSERVATORY
@@ -1013,16 +1080,35 @@ def _prom_label(value) -> str:
     )
 
 
-def _prom_stage_histograms(snap) -> List[str]:
+def _prom_stage_histograms(snap, slow_events=None) -> List[str]:
     """Flight-recorder stage latencies as one native histogram family.
 
     Log2-µs buckets become cumulative ``le`` bounds in seconds (the
     exact inclusive bucket bound, ``(2^b - 1)/1e6``); only non-empty
     buckets are emitted — cumulative series stay valid when sparse.
+
+    When the slow-event ring is passed, bucket lines carry OpenMetrics
+    exemplars pointing at the self-span trace id of an over-budget
+    observation that landed in that bucket — a burning latency SLO
+    links straight to a retrievable pipeline trace. Exemplar syntax
+    (``# {trace_id="..."} <seconds>``) is an OpenMetrics extension that
+    classic text-format parsers treat as a comment, so the exposition
+    stays valid for both.
     """
     stats = snap.nonzero()
     if not stats:
         return []
+    # newest exemplar per (stage, bucket): the ring is oldest-first and
+    # only self-span-enriched events carry a trace id worth linking
+    by_bucket: Dict[Tuple[str, int], Tuple[str, float]] = {}
+    for ev in slow_events or ():
+        trace_id = ev.get("traceId")
+        if not trace_id:
+            continue
+        dur_us = int(ev.get("durUs", 0))
+        by_bucket[(ev["stage"], max(dur_us, 0).bit_length())] = (
+            trace_id, dur_us / 1e6,
+        )
     fam = "zipkin_tpu_stage_latency_seconds"
     lines = [
         f"# HELP {fam} Pipeline stage latency (log2 microsecond buckets).",
@@ -1035,12 +1121,42 @@ def _prom_stage_histograms(snap) -> List[str]:
                 continue
             cum += count
             le = obs.bucket_le_us(b) / 1e6
-            lines.append(
-                f'{fam}_bucket{{stage="{st.stage}",le="{le}"}} {cum}'
-            )
+            line = f'{fam}_bucket{{stage="{st.stage}",le="{le}"}} {cum}'
+            ex = by_bucket.get((st.stage, b))
+            if ex is not None:
+                line += f' # {{trace_id="{_prom_label(ex[0])}"}} {ex[1]}'
+            lines.append(line)
         lines.append(f'{fam}_bucket{{stage="{st.stage}",le="+Inf"}} {st.count}')
         lines.append(f'{fam}_sum{{stage="{st.stage}"}} {st.sum_us / 1e6}')
         lines.append(f'{fam}_count{{stage="{st.stage}"}} {st.count}')
+    return lines
+
+
+def _prom_accuracy(status) -> List[str]:
+    """Per-service digest-error families from the accuracy observatory.
+    The scalar gauges (worst-service, HLL, recall, retention bias) ride
+    the flat ``zipkin_tpu_accuracy_*`` render in ``get_prometheus``;
+    only the service-labelled detail needs its own exposition."""
+    rows = status.get("services") or []
+    if not rows:
+        return []
+    lines: List[str] = []
+    fields = (
+        ("p50RelErr", "p50_relerr", "digest p50 relative error"),
+        ("p99RelErr", "p99_relerr", "digest p99 relative error"),
+        ("p99Bound", "p99_bound", "stated p99 confidence bound"),
+    )
+    for field, suffix, help_text in fields:
+        fam = f"zipkin_tpu_accuracy_service_{suffix}"
+        lines.append(
+            f"# HELP {fam} Per-service {help_text} (device vs shadow)."
+        )
+        lines.append(f"# TYPE {fam} gauge")
+        for row in rows:
+            lines.append(
+                f'{fam}{{service="{_prom_label(row["service"])}"}} '
+                f'{row[field]}'
+            )
     return lines
 
 

@@ -82,6 +82,32 @@ class ServerConfig:
     obs_slo_short_s: float = 60.0
     obs_slo_long_s: float = 300.0
     obs_slo_burn_threshold: float = 2.0
+    # accuracy observatory (zipkin_tpu.obs.shadow + obs.accuracy): a
+    # bounded-memory host shadow of the ingest stream whose exact
+    # sub-stream statistics anchor live relative-error gauges for the
+    # device sketches (digest p50/p99, HLL, link recall, retention
+    # bias). TPU_OBS_SHADOW gates the whole plane (requires the
+    # windowed plane; TPU storage only). Knobs:
+    #   TPU_OBS_SHADOW_RESERVOIR   exact durations kept per service —
+    #                              quantile rank noise ~ 1/sqrt(k)
+    #                              (512 => +-4.4% p99 rank at 3 sigma)
+    #   TPU_OBS_SHADOW_DISTINCT    trace ids kept by the adaptive
+    #                              distinct sketch — HLL-oracle rel.
+    #                              stderr ~ 1.2/sqrt(k)
+    #   TPU_OBS_SHADOW_LINK_RATE   fraction of traces whose spans are
+    #                              retained whole for the dependency-
+    #                              recall oracle (trace-affine hash)
+    #   TPU_OBS_SHADOW_ROLLUP_S    estimator cadence (device reads ride
+    #                              the one-transfer read path)
+    #   TPU_OBS_SHADOW_PENDING     max buffered ingest batches; overflow
+    #                              drops oldest and degrades the plane
+    #                              to "no signal" via coverage gating
+    obs_shadow_enabled: bool = True
+    obs_shadow_reservoir_k: int = 512
+    obs_shadow_distinct_k: int = 4096
+    obs_shadow_link_rate: float = 0.125
+    obs_shadow_rollup_s: float = 5.0
+    obs_shadow_pending_max: int = 512
     # TPU aggregation tier
     tpu_devices: Optional[int] = None  # None = all visible
     tpu_batch_size: int = 8192
@@ -199,6 +225,12 @@ class ServerConfig:
             obs_slo_short_s=_env_float("TPU_SLO_SHORT_S", 60.0),
             obs_slo_long_s=_env_float("TPU_SLO_LONG_S", 300.0),
             obs_slo_burn_threshold=_env_float("TPU_SLO_BURN", 2.0),
+            obs_shadow_enabled=_env_bool("TPU_OBS_SHADOW", True),
+            obs_shadow_reservoir_k=_env_int("TPU_OBS_SHADOW_RESERVOIR", 512),
+            obs_shadow_distinct_k=_env_int("TPU_OBS_SHADOW_DISTINCT", 4096),
+            obs_shadow_link_rate=_env_float("TPU_OBS_SHADOW_LINK_RATE", 0.125),
+            obs_shadow_rollup_s=_env_float("TPU_OBS_SHADOW_ROLLUP_S", 5.0),
+            obs_shadow_pending_max=_env_int("TPU_OBS_SHADOW_PENDING", 512),
             tpu_devices=_env_int("TPU_DEVICES", 0) or None,
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_fast_ingest=fast_ingest,

@@ -358,6 +358,10 @@ class MultiProcessIngester:
         for p in self._procs:
             p.start()
         self.metrics = metrics  # CollectorMetrics-shaped, optional
+        # accuracy-observatory tap (obs/shadow.py): when attached, every
+        # applied chunk's fused image is offered (O(1) bounded append —
+        # the fused array is already this dispatcher's private copy)
+        self.shadow = None
         self.counters = {
             "accepted": 0, "sampleDropped": 0, "fallbacks": 0, "rejected": 0,
         }
@@ -828,6 +832,8 @@ class MultiProcessIngester:
                     rec = sampler.gate_record(rec)
                 if rec is not None:
                     store.disk_append_record(rec)
+            if self.shadow is not None:
+                self.shadow.offer_fused(fused)
             store.agg.ingest_fused(
                 fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
                 ts_range=ts_range,

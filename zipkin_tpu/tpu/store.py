@@ -227,6 +227,12 @@ class TpuStorage(
         # server sets this so ingest_counters() surfaces the tier's
         # gauges and close() can tear a forgotten tier down
         self.mp_ingester = None
+        # accuracy observatory (obs/shadow.py + obs/accuracy.py): the
+        # server attaches both when the shadow plane is enabled; the
+        # fast path offers its columnar batches to the shadow and
+        # ingest_counters() merges the accuracy gauges
+        self.shadow = None
+        self.accuracy = None
         # interning id-space coherence: the C-side vocab (fast path) and
         # the Python vocab (object path) assign ids sequentially; any
         # operation that interns must hold this lock so the orders match.
@@ -624,6 +630,10 @@ class TpuStorage(
         else:
             self._archive_fast_sample(retained, retained.n)
         obs.record("archive_write", time.perf_counter() - t0)
+        if self.shadow is not None:
+            # ground-truth tap: the shadow audits the same full batch
+            # the device sketches see (pre-retention), O(1) append
+            self.shadow.offer_cols(cols)
         self.agg.ingest(cols)
 
     def _sampled_parsed(self, parsed, keep):
@@ -1288,6 +1298,14 @@ class TpuStorage(
             # mpRejected ...): present only when the MP tier is attached
             **(
                 self.mp_ingester.stats() if self.mp_ingester is not None else {}
+            ),
+            # accuracy-observatory gauges (accuracyDigestP99RelErr /
+            # accuracyHllRelErr / accuracyLinkRecall / shadow* ...):
+            # present only when the shadow plane is attached
+            **(
+                self.accuracy.export_counters()
+                if self.accuracy is not None
+                else {}
             ),
         }
 
