@@ -1,5 +1,5 @@
 """Fan-out tier benchmark: wire-to-ack spans/s across the full matrix
-(INGEST_r07 artifact; BENCH_MODE=fanout in bench.py).
+(INGEST_r08 artifact; BENCH_MODE=fanout in bench.py).
 
 Measures what the ingest fan-out PR claims: sustained spans/s from wire
 bytes to ack through the REAL server boundary, as a function of
@@ -11,8 +11,11 @@ bytes to ack through the REAL server boundary, as a function of
 
 plus a per-stage µs/span decomposition from the obs flight recorder
 (snapshot delta across each leg: boundary / parse / pack / route /
-mp_record / device feed), and a 429-backpressure onset probe showing
-exactly when the bounded per-worker queues start pushing back.
+mp_record and its shm-copy/vocab-replay/LUT-remap/device-feed
+substages), a per-cell **critpath report** from the interval-ledger
+stitcher (exact wire-to-durable p50/p99, queue-wait vs service split,
+Little's-law gauges, conservation), and a 429-backpressure onset probe
+showing exactly when the bounded per-worker queues start pushing back.
 
 Throughput legs retry on 429/RESOURCE_EXHAUSTED with backoff (the
 documented client contract) and the drain tail counts toward elapsed —
@@ -23,7 +26,7 @@ claim is the multi-core EVALS config (evals/run_configs.py fanout).
 
 Run: ``BENCH_MODE=fanout python bench.py`` or
 ``python -m benchmarks.ingest_fanout``. Writes INGEST_FANOUT_OUT
-(default INGEST_r07.json) and prints the same JSON on stdout.
+(default INGEST_r08.json) and prints the same JSON on stdout.
 """
 
 from __future__ import annotations
@@ -42,7 +45,8 @@ def _stage_delta(snap0, snap1, accepted: int) -> dict:
     out = {}
     for st in (
         "http_boundary", "grpc_boundary", "parse", "pack", "route",
-        "mp_record", "device_dispatch", "wal_append",
+        "mp_record", "mp_shm_copy", "mp_vocab_replay", "mp_lut_remap",
+        "mp_device_feed", "device_dispatch", "wal_append",
     ):
         d_sum = snap1.stage(st).sum_us - snap0.stage(st).sum_us
         d_count = snap1.stage(st).count - snap0.stage(st).count
@@ -86,6 +90,21 @@ async def _leg(
     storage.agg.block_until_ready()
     snap1 = obs.RECORDER.snapshot()
     accepted = storage.ingest_counters()["spans"] - warm
+    critpath = None
+    ing = server._mp_ingester
+    if ing is not None and ing.critpath is not None:
+        # stitch the drained ledger and ship the per-cell waterfall:
+        # the queue-wait/service/substage split behind the throughput
+        wf = await asyncio.to_thread(ing.critpath.waterfall)
+        critpath = {
+            "timelines": wf["timelines"],
+            "skipped": wf["skipped"],
+            "wire_to_durable_us": wf["wireToDurable"],
+            "conservation": wf["conservation"],
+            "queue_wait_vs_service": wf["queueWaitVsService"],
+            "littles_law": wf["littlesLaw"],
+            "segments": wf["segments"],
+        }
     await server.stop()
     return {
         "transport": transport,
@@ -95,6 +114,7 @@ async def _leg(
         "spans": accepted,
         "backpressure_429": stats["backpressure"],
         "stage_us_per_span": _stage_delta(snap0, snap1, accepted),
+        "critpath": critpath,
     }
 
 
@@ -176,10 +196,13 @@ async def run() -> dict:
                 )
                 i += 1
                 cells.append(cell)
+                cp = cell["critpath"] or {}
+                w2d = (cp.get("wire_to_durable_us") or {}).get("p99Us", 0)
                 print(
                     f"{transport:<5} {fmt:<7} w={cell['workers']}"
                     f" {cell['spans_per_sec']:>12,.0f} spans/s"
-                    f"  429s={cell['backpressure_429']}",
+                    f"  429s={cell['backpressure_429']}"
+                    f"  w2d_p99={w2d}us",
                     file=sys.stderr,
                 )
     onset = _onset_probe(payloads["proto3"], batch)
@@ -201,7 +224,7 @@ async def run() -> dict:
 
 def main() -> None:
     result = asyncio.run(run())
-    out = os.environ.get("INGEST_FANOUT_OUT", "INGEST_r07.json")
+    out = os.environ.get("INGEST_FANOUT_OUT", "INGEST_r08.json")
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")

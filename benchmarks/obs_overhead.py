@@ -25,6 +25,13 @@ on the FULL sink's fast dispatch path on vs off, same < 2% bar, plus
 the steady-state cost of one accuracy rollup (which runs off-path on
 the ticker thread at 5 s cadence).
 
+ISSUE 11 adds a fourth A/B over the critical-path tracer: the interval
+ledger's alloc/stamp/ack writes ride the boundary submit, the spawn
+workers, and the dispatch core — none of which the null sink has — so
+this leg drives the FULL sink through the MP tier (workers=1) with
+``TPU_OBS_CRITPATH`` flipped. Same < 2% bar: a stamp is a handful of
+seqlocked word stores, and the stitcher runs on the ticker thread.
+
 Run from the repo root: ``python -m benchmarks.obs_overhead``
 (OBS_BENCH_SPANS, OBS_BENCH_PORT) or ``BENCH_MODE=obs python bench.py``.
 """
@@ -135,6 +142,31 @@ async def run() -> dict:
         / shadow_best["off"] * 100.0
     rollup_ms = await asyncio.to_thread(_shadow_rollup_cost_ms)
 
+    # -- critpath A/B (ISSUE 11): the interval ledger on the REAL
+    # traced path — boundary alloc+enqueue stamp, worker parse/pack/
+    # route/slot-wait stamps, dispatcher substage stamps, durable ack —
+    # so the leg runs the MP tier (workers=1; on a one-core host the
+    # worker time-slices with the loop, identically on both sides).
+    # Shadow off so the delta isolates the ledger writes.
+    critpath_best = {"on": 0.0, "off": 0.0}
+    for _ in range(pairs):
+        for label, on in (("on", True), ("off", False)):
+            leg = await _run_leg(
+                "full", "json", port + i, 1, payloads, batch, total,
+                config_overrides={
+                    "obs_windows_enabled": True,
+                    "obs_windows_tick_s": 1.0,
+                    "obs_shadow_enabled": False,
+                    "obs_critpath_enabled": on,
+                },
+            )
+            i += 1
+            critpath_best[label] = max(
+                critpath_best[label], leg["spans_per_sec"]
+            )
+    critpath_pct = (critpath_best["off"] - critpath_best["on"]) \
+        / critpath_best["off"] * 100.0
+
     # -- steady-state recompile check: a leg that DOES dispatch device
     # programs (the null sink never does), warmed, then counted
     recompiles = await asyncio.to_thread(_steady_state_recompiles)
@@ -153,6 +185,9 @@ async def run() -> dict:
         "spans_per_sec_shadow_off": shadow_best["off"],
         "spans_per_sec_shadow_on": shadow_best["on"],
         "accuracy_rollup_ms_steady": round(rollup_ms, 2),
+        "critpath_overhead_pct": round(critpath_pct, 3),
+        "spans_per_sec_critpath_off": critpath_best["off"],
+        "spans_per_sec_critpath_on": critpath_best["on"],
         "device_recompiles_steady_state": recompiles,
         "spans_per_leg": total,
         "pairs": pairs,

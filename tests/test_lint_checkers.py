@@ -815,6 +815,69 @@ def test_zt08_ignores_shadow_named_attribute_elsewhere(tmp_path):
     assert rules(result) == []
 
 
+def test_zt08_flags_critpath_stamp_inside_jitted_def(tmp_path):
+    # interval-ledger writes are seqlocked shm mutation + perf_counter
+    # reads: a traced region would stamp one trace-time interval forever
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import critpath
+
+        @jax.jit
+        def kernel(x):
+            critpath.stamp_active(critpath.SEG_DEVICE_FEED, 0, 1)
+            return x
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_critpath_stitch_reachable_from_traced_code(tmp_path):
+    # the stitcher folds slots under a lock and mutates aggregate state
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs.critpath import stitch
+
+        def _fold(x):
+            stitch()
+            return x
+
+        def kernel(x):
+            return _fold(x)
+
+        run = jax.jit(kernel)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_clean_host_side_critpath_hooks(tmp_path):
+    # stamping from the dispatcher / stitching on the ticker is the
+    # intended use — only traced reachability is the violation
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import critpath
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def dispatch(ledger, slot, pid):
+            critpath.set_active(ledger, slot, pid)
+            critpath.stamp_active(critpath.SEG_WAL_APPEND, 0, 1)
+            critpath.clear_active()
+            ledger.ack(slot, pid)
+            return kernel(slot)
+        """,
+    )
+    assert rules(result) == []
+
+
 # -- ZT09: dispatch-critical loops ---------------------------------------
 
 
@@ -887,3 +950,31 @@ def test_zt09_marker_without_reason_is_flagged(tmp_path):
         """,
         "ZT09",
     )
+
+
+def test_zt09_critpath_ledger_writer_shape(tmp_path):
+    # the interval-ledger writers are marked zt-dispatch-critical and
+    # must stay loop-free: a handful of word stores per stamp. The
+    # marked-with-loop variant trips; the straight-line variant (the
+    # shipped critpath.stamp shape) lints clean.
+    assert_rule_owned(
+        tmp_path,
+        """
+        def stamp(self, slot, code, t0, t1):  # zt-dispatch-critical: ledger write
+            for w in (code, t0, t1):
+                self.a[slot] = w
+        """,
+        "ZT09",
+    )
+    result = lint(
+        tmp_path,
+        """
+        def stamp(self, slot, code, t0, t1):  # zt-dispatch-critical: seqlocked word stores, no loops
+            self.a[slot] += 1
+            self.a[slot + 1] = code
+            self.a[slot + 2] = t0
+            self.a[slot + 3] = t1
+            self.a[slot] += 1
+        """,
+    )
+    assert rules(result) == []

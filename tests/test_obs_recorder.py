@@ -200,6 +200,7 @@ class TestTaxonomy:
             "device_dispatch", "rollup", "ctx_advance", "wal_append",
             "wal_fsync", "snapshot", "sampler_tick", "archive_write",
             "query_fresh", "query_cached", "readpack_transfer", "mp_record",
-            "accuracy_rollup",
+            "mp_shm_copy", "mp_vocab_replay", "mp_lut_remap",
+            "mp_device_feed", "accuracy_rollup", "wire_to_durable",
         }
         assert set(STAGES) == expected

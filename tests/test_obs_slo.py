@@ -69,7 +69,58 @@ def test_spec_grammar_rejects_malformed():
 def test_default_specs_cover_north_star():
     names = {s.name for s in default_specs()}
     assert {"ingest_wire_to_ack", "query_fresh_p99",
-            "durability_wal_fsync", "backpressure_429"} <= names
+            "durability_wal_fsync", "backpressure_429",
+            "ingest_wire_to_durable", "ingest_queue_saturation"} <= names
+
+
+def test_wire_to_durable_slo_trips_and_clears():
+    """The critpath stitcher feeds wire_to_durable observations through
+    record_relayed (worker-measured relay: no self-span feedback); the
+    default-shaped latency spec must trip on sustained slow timelines
+    and clear when the fleet recovers."""
+    spec = SloSpec("ingest_wire_to_durable", "latency", short_s=4,
+                   long_s=8, burn_threshold=2.0, objective=0.99,
+                   stage="wire_to_durable", threshold_us=5_000_000)
+    h = Harness([spec])
+    # healthy: chunks reach durable in ~3 ms
+    for _ in range(4):
+        for _ in range(20):
+            h.rec.record_relayed("wire_to_durable", 0.003)
+        h.tick()
+    assert not h.verdict("ingest_wire_to_durable")["alert"]
+    # fan-out tier backs up: half the chunks take 8 s wire->fsync
+    # (bad frac 0.5, budget 0.01 -> burn 50 on both windows)
+    for _ in range(8):
+        for _ in range(10):
+            h.rec.record_relayed("wire_to_durable", 0.003)
+            h.rec.record_relayed("wire_to_durable", 8.0)
+        h.tick()
+    v = h.verdict("ingest_wire_to_durable")
+    assert v["alert"]
+    assert v["windows"]["4s"]["burn"] >= 2.0
+    assert h.dog.trips == 1
+    # recovery: healthy timelines age the burn out of both windows
+    for _ in range(9):
+        for _ in range(20):
+            h.rec.record_relayed("wire_to_durable", 0.003)
+        h.tick()
+    assert not h.verdict("ingest_wire_to_durable")["alert"]
+    assert h.dog.clears == 1
+
+
+def test_queue_saturation_gauge_spec_reads_stitcher_counter():
+    """The queue-saturation spec is a gauge over the stitcher-published
+    critpathQueueSaturation counter: above limit trips, zeroed-on-idle
+    clears (the stitcher zeroes the gauge when a stitch folds nothing)."""
+    spec = SloSpec("ingest_queue_saturation", "gauge", short_s=4,
+                   long_s=8, gauge="critpathQueueSaturation", limit=0.9)
+    h = Harness([spec])
+    h.vals["critpathQueueSaturation"] = 0.97
+    h.tick()
+    assert h.verdict("ingest_queue_saturation")["alert"]
+    h.vals["critpathQueueSaturation"] = 0.0  # idle stitch zeroes it
+    h.tick()
+    assert not h.verdict("ingest_queue_saturation")["alert"]
 
 
 # -- latency kind --------------------------------------------------------

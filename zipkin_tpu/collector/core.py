@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from zipkin_tpu import obs
 from zipkin_tpu.model import codec
+from zipkin_tpu.obs import critpath
 from zipkin_tpu.model.span import Span
 from zipkin_tpu.storage.spi import StorageComponent
 from zipkin_tpu.utils.component import Component
@@ -191,6 +192,14 @@ class Collector:
                 # sniffs the wire format (ISSUE 8).
                 from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
 
+                tok = None
+                if critpath.WIRE_T0_NS.get() == 0:
+                    # direct submitters (tests, benches driving the
+                    # collector without a server boundary) still get
+                    # wire-to-durable timelines, measured from collector
+                    # entry; token-reset so a long-lived caller thread
+                    # stamps fresh per payload
+                    tok = critpath.WIRE_T0_NS.set(time.perf_counter_ns())
                 try:
                     # non-blocking at the boundary: a full tier must
                     # surface as 429/RESOURCE_EXHAUSTED, not as the
@@ -199,6 +208,9 @@ class Collector:
                 except IngestBackpressure:
                     self.metrics.increment_messages_dropped()
                     raise
+                finally:
+                    if tok is not None:
+                        critpath.WIRE_T0_NS.reset(tok)
                 return 0
         # the native tier parses JSON v2 AND proto3 ListOfSpans (r4:
         # gRPC/proto3 ingest was the one first-class hot codec still on

@@ -61,13 +61,21 @@ _HOOK_ATTRS = {
     "tick", "tick_if_due", "observe", "wrap",
     "offer_cols", "offer_fused", "offer_spans", "drain",
     "rollup", "maybe_rollup",
+    # critical-path tracer (ISSUE 11): ledger writes are seqlocked
+    # shared-memory mutation + perf_counter reads, and the stitcher
+    # folds under a lock — all host-only. A traced region would stamp
+    # one trace-time interval forever (or fail under tracing).
+    "stamp", "stamp_active", "alloc", "ack", "abandon", "release",
+    "stitch", "calibrate", "set_active", "clear_active",
 }
 _HOOK_ROOTS = {
     "obs", "WINDOWS", "OBSERVATORY", "obs_device", "SHADOW", "ACCURACY",
+    "critpath", "_critpath", "CRITPATH",
 }
 _HOOK_MODULES = {
     "zipkin_tpu.obs.windows", "zipkin_tpu.obs.device",
     "zipkin_tpu.obs.shadow", "zipkin_tpu.obs.accuracy",
+    "zipkin_tpu.obs.critpath",
 }
 _TRACE_NAMES = {"jit", "shard_map"}
 

@@ -52,6 +52,10 @@ class SelfSpanEmitter:
         self.budget_scale = float(budget_scale)
         self.min_interval_s = float(min_interval_s)
         self._queue: deque = deque(maxlen=queue_size)
+        # pre-built spans from other planes (critpath slow-chunk
+        # timelines): already Span objects, just need the suppressed
+        # collector hand-off the drain thread provides
+        self._prebuilt: deque = deque(maxlen=queue_size)
         self._last_emit: Dict[str, float] = {}
         self._suppress = threading.local()
         self._endpoint = Endpoint.create(service_name=SERVICE_NAME,
@@ -101,6 +105,16 @@ class SelfSpanEmitter:
         self._last_emit[stage] = now
         self._queue.append(dict(event))
 
+    def emit_spans(self, spans) -> None:
+        """Queue already-built self-spans (e.g. a critpath timeline).
+
+        Bounded append only — safe from any thread; the drain thread
+        publishes them under the same suppression guard as slow-stage
+        events, so the hand-off cannot re-trigger itself.
+        """
+        for s in spans:
+            self._prebuilt.append(s)
+
     # -- drain-thread side ---------------------------------------------
 
     def _drain_loop(self) -> None:
@@ -117,6 +131,11 @@ class SelfSpanEmitter:
             except IndexError:
                 break
             spans.append(self._span_for(ev))
+        while True:
+            try:
+                spans.append(self._prebuilt.popleft())
+            except IndexError:
+                break
         if not spans:
             return 0
         self._suppress.on = True

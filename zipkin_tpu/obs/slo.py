@@ -106,6 +106,19 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
                 gauge="accuracyHllDrift", limit=0.15, **kw),
         SloSpec("hll_envelope", "ratio", objective=0.99,
                 bad="hllEnvelopeExceeded", total="hostTransfers", **kw),
+        # Critical-path tracer (obs/critpath.py): wire-to-durable is the
+        # END of the ingest story — boundary read through wal fsync — a
+        # strictly longer interval than wire-to-ack's 202-on-enqueue.
+        # 5 s covers the dispatcher's coalescing window plus a device
+        # feed with headroom; sustained excess means the fan-out tier is
+        # backed up, not merely busy.
+        SloSpec("ingest_wire_to_durable", "latency", objective=0.99,
+                stage="wire_to_durable", threshold_us=5_000_000, **kw),
+        # Little's-law queue saturation gauge from the stitcher: lambda
+        # x mean(queue-wait + slot-wait) over total queue capacity.
+        # Zeroed on idle ticks, so a stale reading cannot hold an alert.
+        SloSpec("ingest_queue_saturation", "gauge",
+                gauge="critpathQueueSaturation", limit=0.9, **kw),
     ]
 
 

@@ -37,7 +37,12 @@ STAGES = (
     "query_cached",      # read-path cache hit under the version check
     "readpack_transfer",  # the single packed device→host pull per query
     "mp_record",         # MP dispatcher: shm copy + remap + device feed
+    "mp_shm_copy",       # mp_record substage: shm slot → host array copy
+    "mp_vocab_replay",   # mp_record substage: worker vocab journal replay
+    "mp_lut_remap",      # mp_record substage: worker-local → global LUT remap
+    "mp_device_feed",    # mp_record substage: fused batch → device ingest feed
     "accuracy_rollup",   # shadow drain + device reads + error estimators
+    "wire_to_durable",   # stitched critical path: wire receipt → WAL-durable ack
 )
 
 NUM_STAGES = len(STAGES)
@@ -62,7 +67,12 @@ DEFAULT_BUDGETS_US = {
     "query_cached": 50_000,
     "readpack_transfer": 100_000,
     "mp_record": 500_000,
+    "mp_shm_copy": 250_000,
+    "mp_vocab_replay": 250_000,
+    "mp_lut_remap": 250_000,
+    "mp_device_feed": 500_000,
     "accuracy_rollup": 1_000_000,
+    "wire_to_durable": 5_000_000,
 }
 
 assert set(DEFAULT_BUDGETS_US) == set(STAGES)

@@ -38,6 +38,7 @@ from zipkin_tpu.collector.core import (
 )
 from zipkin_tpu.internal.hex import normalize_trace_id
 from zipkin_tpu.model import codec, json_v2
+from zipkin_tpu.obs import critpath
 from zipkin_tpu.model.codec import Encoding
 from zipkin_tpu.server.config import ServerConfig
 from zipkin_tpu.storage.memory import InMemoryStorage
@@ -162,6 +163,14 @@ class ZipkinServer:
                     sampler=sampler,
                     queue_depth=self.config.tpu_mp_queue_depth,
                     metrics=http_metrics,
+                    # ingest critical-path tracer (ISSUE 11): size the
+                    # shared-memory interval ledger; 0 disables tracing
+                    critpath_slots=(
+                        self.config.obs_critpath_slots
+                        if self.config.obs_critpath_enabled
+                        else 0
+                    ),
+                    critpath_reclaim_s=self.config.obs_critpath_reclaim_s,
                 )
                 # surface the tier's gauges on ingest_counters() —
                 # /metrics, /prometheus and /statusz all read it — and
@@ -197,6 +206,15 @@ class ZipkinServer:
                 budget_scale=self.config.obs_budget_scale,
             )
             self._obs_emitter.install(obs.RECORDER)
+        # slowest-chunk critpath timelines ride the self-span plane when
+        # both are armed: the stitcher hands pre-built spans to the
+        # emitter's suppressed drain thread
+        if (
+            self._mp_ingester is not None
+            and getattr(self._mp_ingester, "critpath", None) is not None
+            and self._obs_emitter is not None
+        ):
+            self._mp_ingester.critpath.emitter = self._obs_emitter
         # windowed telemetry plane + SLO watchdog (ISSUE 9): per-tick
         # delta rings over the recorder/counters, burn-rate evaluation
         # on every tick. The ticker thread follows start()/stop();
@@ -255,6 +273,16 @@ class ZipkinServer:
                 self._obs_windows.on_tick(
                     lambda _w: self._accuracy.maybe_rollup()
                 )
+            # critpath stitcher on the windows ticker, BEFORE the
+            # watchdog for the same reason as the accuracy rollup: each
+            # tick folds completed ledger slots (feeding the
+            # wire_to_durable histogram + saturation gauges) before burn
+            # evaluation reads them, so alerts lag at most one tick.
+            if (
+                self._mp_ingester is not None
+                and getattr(self._mp_ingester, "critpath", None) is not None
+            ):
+                self._obs_windows.on_tick(self._mp_ingester.critpath.on_tick)
             if self.config.obs_slo_enabled:
                 from zipkin_tpu.obs.slo import SloWatchdog, default_specs
 
@@ -513,6 +541,10 @@ class ZipkinServer:
 
     async def _ingest(self, request: web.Request, *, v1: bool) -> web.Response:
         t0 = time.perf_counter()
+        # critpath wire anchor: the same instant http_boundary measures
+        # from, in the ns domain the interval ledger uses. Contextvars
+        # survive asyncio.to_thread, so the MP submit path reads it.
+        critpath.WIRE_T0_NS.set(int(t0 * 1e9))
         try:
             body = await self._read_body(request)
         except PayloadTooLarge as e:
@@ -811,6 +843,17 @@ class ZipkinServer:
             ):
                 if name in counters:
                     out[f"gauge.zipkin_tpu.{name}"] = counters[name]
+            # critical-path stitcher (ISSUE 11): timeline accounting and
+            # the Little's-law saturation gauges behind the queue SLO
+            for name in (
+                "critpathTimelines", "critpathSkipped", "critpathAbandoned",
+                "critpathReclaimed", "critpathDegraded", "critpathTruncated",
+                "critpathLambdaCps", "critpathLittleL",
+                "critpathWorkerOccupancy", "critpathQueueSaturation",
+                "critpathConservationP50Milli",
+            ):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
         # sampling-tier gauges (ISSUE 4): retention verdict tallies, the
         # controller's budget posture, and the live per-service keep rate
         if getattr(self.storage, "sampler", None) is not None:
@@ -891,6 +934,7 @@ class ZipkinServer:
                 lines.append(f"# TYPE {fam} gauge")
                 lines.append(f"{fam} {value}")
             lines.extend(_prom_mp_workers(counters.get("mpWorkerTable")))
+            lines.extend(_prom_critpath(counters.get("critpathSegments")))
         if getattr(self.storage, "sampler", None) is not None:
             # live per-service keep probability (1.0 = keep everything)
             rates = await asyncio.to_thread(self.storage.sampler_rates)
@@ -996,6 +1040,12 @@ class ZipkinServer:
             stats = await asyncio.to_thread(ing.stats)
             if "mpWorkerTable" in stats:
                 body["workers"] = stats["mpWorkerTable"]
+            # ingest waterfall (ISSUE 11): exact windowed wire-to-durable,
+            # queue-wait vs service decomposition, Little's-law gauges,
+            # and the slowest folded chunk's segment timeline
+            cp = getattr(ing, "critpath", None)
+            if cp is not None:
+                body["critpath"] = await asyncio.to_thread(cp.waterfall)
         return web.json_response(body)
 
     def _durability_status(self) -> Optional[dict]:
@@ -1183,6 +1233,46 @@ def _prom_mp_workers(table) -> List[str]:
         for row in table:
             lines.append(
                 f'{fam}{{worker="{_prom_label(row["widx"])}"}} {row[field]}'
+            )
+    # instantaneous queue posture (ISSUE 11 satellite): depth is live
+    # occupancy, high-water the worst since boot — gauges, not counters
+    gauges = (
+        ("queueDepth", "live bounded-queue depth (payloads in flight)"),
+        ("queueHighWater", "bounded-queue depth high-water mark"),
+    )
+    for field, help_text in gauges:
+        fam = _prom_name(f"zipkin_tpu_mp_worker_{_snake(field)}")
+        lines.append(f"# HELP {fam} Ingest worker {help_text}.")
+        lines.append(f"# TYPE {fam} gauge")
+        for row in table:
+            lines.append(
+                f'{fam}{{worker="{_prom_label(row["widx"])}"}} '
+                f'{row.get(field, 0)}'
+            )
+    return lines
+
+
+def _prom_critpath(segments) -> List[str]:
+    """Critical-path segment families from the stitcher's fold
+    aggregates. The scalar gauges (timelines, lambda, occupancy,
+    saturation, conservation) ride the flat ``zipkin_tpu_critpath_*``
+    render; the per-segment table needs segment+kind labels."""
+    if not segments:
+        return []
+    lines: List[str] = []
+    fields = (
+        ("count", "folded occurrences", "counter", "_total"),
+        ("sumUs", "cumulative wall microseconds", "counter", "_total"),
+        ("maxUs", "worst single occurrence microseconds", "gauge", ""),
+    )
+    for field, help_text, typ, suffix in fields:
+        fam = _prom_name(f"zipkin_tpu_critpath_segment_{_snake(field)}{suffix}")
+        lines.append(f"# HELP {fam} Critical-path segment {help_text}.")
+        lines.append(f"# TYPE {fam} {typ}")
+        for seg, row in sorted(segments.items()):
+            lines.append(
+                f'{fam}{{segment="{_prom_label(seg)}",'
+                f'kind="{_prom_label(row["kind"])}"}} {row[field]}'
             )
     return lines
 

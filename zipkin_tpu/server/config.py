@@ -102,6 +102,17 @@ class ServerConfig:
     #   TPU_OBS_SHADOW_PENDING     max buffered ingest batches; overflow
     #                              drops oldest and degrades the plane
     #                              to "no signal" via coverage gating
+    # ingest critical-path tracer (zipkin_tpu.obs.critpath): chunk-scoped
+    # wire-to-durable timelines stitched from a shared-memory interval
+    # ledger across the MP fan-out. TPU_OBS_CRITPATH gates the plane
+    # (active only when the MP tier runs); TPU_OBS_CRITPATH_SLOTS sizes
+    # the ledger (one slot per in-flight chunk; overflow degrades to
+    # untraced, counted critpathSkipped). TPU_OBS_CRITPATH_RECLAIM_S is
+    # the stale-slot reclaim age (a SIGKILL'd worker's orphaned slot is
+    # abandoned after this long so timelines cannot wedge).
+    obs_critpath_enabled: bool = True
+    obs_critpath_slots: int = 256
+    obs_critpath_reclaim_s: float = 60.0
     obs_shadow_enabled: bool = True
     obs_shadow_reservoir_k: int = 512
     obs_shadow_distinct_k: int = 4096
@@ -225,6 +236,11 @@ class ServerConfig:
             obs_slo_short_s=_env_float("TPU_SLO_SHORT_S", 60.0),
             obs_slo_long_s=_env_float("TPU_SLO_LONG_S", 300.0),
             obs_slo_burn_threshold=_env_float("TPU_SLO_BURN", 2.0),
+            obs_critpath_enabled=_env_bool("TPU_OBS_CRITPATH", True),
+            obs_critpath_slots=_env_int("TPU_OBS_CRITPATH_SLOTS", 256),
+            obs_critpath_reclaim_s=_env_float(
+                "TPU_OBS_CRITPATH_RECLAIM_S", 60.0
+            ),
             obs_shadow_enabled=_env_bool("TPU_OBS_SHADOW", True),
             obs_shadow_reservoir_k=_env_int("TPU_OBS_SHADOW_RESERVOIR", 512),
             obs_shadow_distinct_k=_env_int("TPU_OBS_SHADOW_DISTINCT", 4096),

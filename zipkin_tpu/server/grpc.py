@@ -37,12 +37,27 @@ import grpc.aio
 from zipkin_tpu import obs
 from zipkin_tpu.collector.core import Collector
 from zipkin_tpu.model.codec import Encoding
+from zipkin_tpu.obs import critpath
 from zipkin_tpu.obs.selfspans import CURRENT_B3
 
 logger = logging.getLogger(__name__)
 
 SERVICE = "zipkin.proto3.SpanService"
 METHOD = f"/{SERVICE}/Report"
+
+
+def _stamped_request(data: bytes):
+    """Request deserializer that timestamps message receipt.
+
+    grpc's C core assembles the request message (socket reads, HTTP/2
+    reassembly, the ~5 MB body of a 64k-span ListOfSpans) BEFORE the
+    Python handler runs, so a ``t0`` taken inside ``report()`` misses
+    the read entirely — INGEST_r07 showed ``grpc_boundary`` at 0.16 µs
+    vs ``http_boundary``'s 0.73 µs for identical proto3 work. The
+    deserializer is the earliest Python hook after assembly: stamping
+    here makes the stage span request read + decode like the HTTP
+    site's (whose t0 precedes ``request.read()``)."""
+    return time.perf_counter_ns(), data
 
 
 class _SpanServiceHandler(grpc.GenericRpcHandler):
@@ -53,8 +68,9 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
         if handler_call_details.method != METHOD:
             return None
 
-        async def report(request: bytes, context) -> bytes:
-            t0 = time.perf_counter()
+        async def report(request, context) -> bytes:
+            t0_ns, data = request
+            critpath.WIRE_T0_NS.set(t0_ns)
             md = dict(context.invocation_metadata() or ())
             tid, sid = md.get("x-b3-traceid"), md.get("x-b3-spanid")
             sampled = str(md.get("x-b3-sampled", "")).lower()
@@ -65,7 +81,7 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
                 # off the event loop: decode + device ingest block, and the
                 # loop is shared with the HTTP site (same fix as app.py)
                 await asyncio.to_thread(
-                    self._collector.accept_spans_bytes, request, Encoding.PROTO3
+                    self._collector.accept_spans_bytes, data, Encoding.PROTO3
                 )
             except ValueError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -77,12 +93,14 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
             finally:
                 if token is not None:
                     CURRENT_B3.reset(token)
-            obs.record("grpc_boundary", time.perf_counter() - t0)
+            obs.record(
+                "grpc_boundary", (time.perf_counter_ns() - t0_ns) / 1e9
+            )
             return b""  # empty ReportResponse
 
         return grpc.unary_unary_rpc_method_handler(
             report,
-            request_deserializer=None,  # raw bytes: our codec decodes
+            request_deserializer=_stamped_request,  # (t_recv_ns, bytes)
             response_serializer=None,
         )
 

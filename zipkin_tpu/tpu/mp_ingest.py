@@ -64,6 +64,7 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from zipkin_tpu import obs
+from zipkin_tpu.obs import critpath as _critpath
 
 logger = logging.getLogger(__name__)
 
@@ -113,10 +114,21 @@ def _worker_main(
 
     from zipkin_tpu import native
     from zipkin_tpu.native import PARSED_FIELDS
+    from zipkin_tpu.obs.critpath import (
+        SEG_PACK,
+        SEG_PARSE,
+        SEG_ROUTE,
+        SEG_SLOT_WAIT,
+        CritPathWorkerView,
+    )
     from zipkin_tpu.tpu.archive import parsed_record
     from zipkin_tpu.tpu.columnar import Vocab, pack_parsed, route_fused
 
     shm = shared_memory.SharedMemory(name=shm_name)
+    cp_params = params.get("critpath")
+    cview = (
+        CritPathWorkerView(cp_params, widx) if cp_params is not None else None
+    )
     vocab = Vocab(params["max_services"], params["max_keys"])
     nvocab = native.NativeVocab(vocab) if native.available() else None
     n_shards = params["n_shards"]
@@ -130,8 +142,15 @@ def _worker_main(
     sent_svc, sent_name, sent_pair = 1, 1, 1
     slot_ids = itertools.cycle(range(n_slots))
 
-    def handle(pid: int, payload: bytes, state: dict) -> None:
+    def handle(pid: int, payload: bytes, state: dict, cslot: int) -> None:
         nonlocal sent_svc, sent_name, sent_pair
+        traced = cview is not None and cslot >= 0
+        if traced:
+            # per-payload recalibration keeps the cross-process clock
+            # bridge fresh; perf_counter floats convert losslessly to ns
+            # at process-uptime magnitudes, so the stamps below reuse
+            # the timestamps the stage timings already take
+            cview.calibrate()
         t0 = time.perf_counter()
         # parse_spans sniffs the wire format: JSON v2 and proto3
         # ListOfSpans both land here, so the fan-out is format-agnostic
@@ -160,6 +179,11 @@ def _worker_main(
                         setattr(parsed, field, col[:n][idx])
                 parsed.n = n = len(idx)
         parse_s = time.perf_counter() - t0
+        if traced:
+            cview.stamp(
+                cslot, SEG_PARSE, int(t0 * 1e9),
+                int((t0 + parse_s) * 1e9),
+            )
         if n == 0:
             state["completed"] = True
             result_q.put(
@@ -193,7 +217,18 @@ def _worker_main(
             sent_svc += len(svc_new)
             sent_name += len(name_new)
             sent_pair += len(pairs_new)
+            ta = time.perf_counter()
             slot_sem.acquire()
+            if traced:
+                tb = time.perf_counter()
+                cview.stamp(cslot, SEG_PACK, int(t1 * 1e9), int(t2 * 1e9))
+                cview.stamp(
+                    cslot, SEG_ROUTE, int(t2 * 1e9),
+                    int((t2 + route_s) * 1e9),
+                )
+                cview.stamp(
+                    cslot, SEG_SLOT_WAIT, int(ta * 1e9), int(tb * 1e9)
+                )
             slot = next(slot_ids)
             dst = np.frombuffer(
                 shm.buf, np.uint32, count=fused.size,
@@ -233,10 +268,10 @@ def _worker_main(
             item = work_q.get()
             if item is None:
                 break
-            pid, payload = item
+            pid, payload, cslot = item
             state: dict = {"completed": False}
             try:
-                handle(pid, payload, state)
+                handle(pid, payload, state, cslot)
             except Exception:  # pragma: no cover - keep the pool alive
                 logging.getLogger(__name__).exception(
                     "mp-ingest worker %d failed on a payload", widx
@@ -249,6 +284,8 @@ def _worker_main(
                     result_q.put((_KIND_FALLBACK, widx, pid))
     finally:
         result_q.put((_KIND_EOF, widx))
+        if cview is not None:
+            cview.close()
         shm.close()
 
 
@@ -287,6 +324,8 @@ class MultiProcessIngester:
         sampler=None,
         queue_depth: Optional[int] = None,
         metrics=None,
+        critpath_slots: int = 0,
+        critpath_reclaim_s: float = 60.0,
     ) -> None:
         from zipkin_tpu import native
         from zipkin_tpu.tpu.columnar import WIRE_ROWS
@@ -342,6 +381,24 @@ class MultiProcessIngester:
                 else None
             ),
         )
+        # critical-path interval ledger (obs/critpath.py): created before
+        # the pool spawns so workers attach by name. The stitcher is
+        # exposed as .critpath; the server registers it on the windows
+        # ticker and the statusz/bench report reads its waterfall.
+        self._cp_ledger = None
+        self.critpath = None
+        self._cslots: Dict[int, int] = {}
+        if critpath_slots > 0:
+            self._cp_ledger = _critpath.CritPathLedger(
+                workers, critpath_slots
+            )
+            self.critpath = _critpath.CritPathStitcher(
+                self._cp_ledger,
+                queue_capacity=workers * self.queue_depth,
+                recorder=obs.RECORDER,
+                reclaim_age_s=critpath_reclaim_s,
+            )
+            params["critpath"] = self._cp_ledger.params()
         self._procs = [
             ctx.Process(
                 target=_worker_main,
@@ -373,6 +430,11 @@ class MultiProcessIngester:
              "packUs": 0, "routeUs": 0, "fallbacks": 0}
             for _ in range(workers)
         ]
+        # live per-worker occupancy (submitted minus finished) and its
+        # high-water mark — the between-ticks saturation signal the
+        # cumulative tallies above cannot show. Mutated under _cv.
+        self._qdepth = [0] * workers
+        self._qhigh = [0] * workers
         self._inflight = 0
         self._cv = threading.Condition()
         self._closed = False
@@ -436,15 +498,43 @@ class MultiProcessIngester:
                 self._next_pid += 1
                 self._pending[pid] = payload
                 self._inflight += 1
+            wire_ns = (
+                _critpath.WIRE_T0_NS.get()
+                if self._cp_ledger is not None
+                else 0
+            )
             for w in live[start:] + live[:start]:
                 with self._cv:
                     if w in self._dead:
                         continue
                     self._assigned[pid] = w
+                cslot = -1
+                if wire_ns:
+                    t_en0 = time.perf_counter_ns()
+                    cslot = self._cp_ledger.alloc(pid, w, wire_ns)
+                    if cslot >= 0:
+                        # stamp + register BEFORE the queue put: the
+                        # dispatcher only writes this slot after the
+                        # worker's result message, so main-side region
+                        # writers stay causally serialized
+                        self._cp_ledger.stamp(
+                            cslot, _critpath.SEG_ENQUEUE, t_en0,
+                            time.perf_counter_ns(), pid,
+                        )
+                        with self._cv:
+                            self._cslots[pid] = cslot
                 try:
-                    self._work_qs[w].put_nowait((pid, payload))
+                    self._work_qs[w].put_nowait((pid, payload, cslot))
+                    with self._cv:
+                        self._qdepth[w] += 1
+                        if self._qdepth[w] > self._qhigh[w]:
+                            self._qhigh[w] = self._qdepth[w]
                     return
                 except queue.Full:
+                    if cslot >= 0:
+                        with self._cv:
+                            self._cslots.pop(pid, None)
+                        self._cp_ledger.abandon(cslot)
                     with self._cv:
                         if pid not in self._pending:
                             return  # a racing reap already refed it
@@ -487,7 +577,9 @@ class MultiProcessIngester:
         with self._cv:
             inflight = self._inflight
             dead = len(self._dead)
-        return {
+            qdepth = list(self._qdepth)
+            qhigh = list(self._qhigh)
+        out = {
             "mpWorkers": self.workers,
             "mpWorkersAlive": self.workers - dead,
             "mpQueueDepth": self.queue_depth,
@@ -499,10 +591,15 @@ class MultiProcessIngester:
             # nested per-worker table — scalar-only consumers
             # (/prometheus gauge emission) skip non-scalar values
             "mpWorkerTable": [
-                {"widx": w, "alive": w not in self._dead, **dict(ws)}
+                {"widx": w, "alive": w not in self._dead,
+                 "queueDepth": qdepth[w], "queueHighWater": qhigh[w],
+                 **dict(ws)}
                 for w, ws in enumerate(self._wstats)
             ],
         }
+        if self.critpath is not None:
+            out.update(self.critpath.counters())
+        return out
 
     def close(self) -> None:
         if self._closed:
@@ -549,6 +646,8 @@ class MultiProcessIngester:
             self._shm.unlink()
         except FileNotFoundError:  # pragma: no cover
             pass
+        if self._cp_ledger is not None:
+            self._cp_ledger.close()
 
     # -- dispatcher ------------------------------------------------------
 
@@ -675,6 +774,9 @@ class MultiProcessIngester:
                     payload = self._pending.get(pid)
                     if payload is None:
                         continue
+                    # the dead worker's ledger slots would stay OPEN
+                    # forever: recycle them now (no stuck timelines)
+                    self._drop_cslot(pid)
                     self._fallback(payload)
                     self.counters["fallbacks"] += 1
                     self._finish(pid)
@@ -711,6 +813,7 @@ class MultiProcessIngester:
             if payload is None:
                 return  # a reap already refed it
             self._buffered.pop(pid, None)
+            self._drop_cslot(pid)  # slow-path retry: timeline abandoned
             self._fallback(payload)
             self.counters["fallbacks"] += 1
             if 0 <= widx < len(self._wstats):
@@ -729,7 +832,9 @@ class MultiProcessIngester:
                 self._sems[widx].release()
             return
         m = self._maps[widx]
+        cs = self._cslots.get(pid, -1) if self._cp_ledger is not None else -1
         if svc_new or name_new or pairs_new:
+            tv0 = time.perf_counter()
             with store._intern_lock:
                 # zt-lint: disable=ZT09 — journal replay is per NEWLY
                 # INTERNED STRING (bounded by vocab capacity, amortized
@@ -748,6 +853,13 @@ class MultiProcessIngester:
                         vocab.key_id(int(m.svc[sl]), int(m.name[nl]))
                         for sl, nl in pairs_new
                     ],
+                )
+            tv1 = time.perf_counter()
+            obs.record("mp_vocab_replay", tv1 - tv0)
+            if cs >= 0:
+                self._cp_ledger.stamp(
+                    cs, _critpath.SEG_VOCAB_REPLAY,
+                    int(tv0 * 1e9), int(tv1 * 1e9), pid,
                 )
         # worker-measured stage wall time: the workers can't touch the
         # in-process flight recorder, so their parse/pack/route timings
@@ -779,9 +891,23 @@ class MultiProcessIngester:
             )
             fused = src.reshape(shape).copy()
             self._sems[widx].release()  # slot free the moment we copied
+            tc1 = time.perf_counter()
+            obs.record("mp_shm_copy", tc1 - t0)
+            if cs >= 0:
+                self._cp_ledger.stamp(
+                    cs, _critpath.SEG_SHM_COPY,
+                    int(t0 * 1e9), int(tc1 * 1e9), pid,
+                )
             from zipkin_tpu.tpu.columnar import remap_fused
 
             remap_fused(fused, m.svc, m.key)
+            tr1 = time.perf_counter()
+            obs.record("mp_lut_remap", tr1 - tc1)
+            if cs >= 0:
+                self._cp_ledger.stamp(
+                    cs, _critpath.SEG_LUT_REMAP,
+                    int(tc1 * 1e9), int(tr1 * 1e9), pid,
+                )
             if rec is not None:
                 # remap the record's svc/rsvc/name/key lanes local ->
                 # global NOW (the journal above covers every id this
@@ -812,6 +938,11 @@ class MultiProcessIngester:
         total = 0
         t0 = time.perf_counter()
         copy_s = 0.0
+        cs = self._cslots.get(pid, -1) if self._cp_ledger is not None else -1
+        if cs >= 0:
+            # arm the thread-local so wal.py's append/fsync stamps land
+            # in this payload's timeline (the WAL rides ingest_fused)
+            _critpath.set_active(self._cp_ledger, cs, pid)
         # zt-lint: disable=ZT09 — per CHUNK (max_batch-sized), not per
         # span; all per-span work inside is vectorized
         for fused, n_spans, n_dur, n_err, ts_range, arch, rec, c_s in (
@@ -834,11 +965,21 @@ class MultiProcessIngester:
                     store.disk_append_record(rec)
             if self.shadow is not None:
                 self.shadow.offer_fused(fused)
+            tf0 = time.perf_counter()
             store.agg.ingest_fused(
                 fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
                 ts_range=ts_range,
             )
+            tf1 = time.perf_counter()
+            obs.record("mp_device_feed", tf1 - tf0)
+            if cs >= 0:
+                self._cp_ledger.stamp(
+                    cs, _critpath.SEG_DEVICE_FEED,
+                    int(tf0 * 1e9), int(tf1 * 1e9), pid,
+                )
             total += n_spans
+        if cs >= 0:
+            _critpath.clear_active()
         obs.record("mp_record", copy_s + (time.perf_counter() - t0))
         self.counters["accepted"] += total
         self.counters["sampleDropped"] += max(dropped, 0)
@@ -846,12 +987,28 @@ class MultiProcessIngester:
             self.metrics.increment_spans(total + max(dropped, 0))
             if dropped > 0:
                 self.metrics.increment_spans_dropped(dropped)
+        if cs >= 0:
+            # durable ack: the WAL append + device feed above completed
+            self._cp_ledger.ack(cs, pid)
         self._finish(pid)
+
+    def _drop_cslot(self, pid: int) -> None:
+        """Abandon a payload's timeline (fallback/reap path): partial
+        stamps would decompose misleadingly, so the slot recycles now."""
+        if self._cp_ledger is None:
+            return
+        with self._cv:
+            cs = self._cslots.pop(pid, -1)
+        if cs >= 0:
+            self._cp_ledger.abandon(cs)
 
     def _finish(self, pid: int) -> None:
         with self._cv:
             self._pending.pop(pid, None)
-            self._assigned.pop(pid, None)
+            w = self._assigned.pop(pid, None)
+            self._cslots.pop(pid, None)
+            if w is not None and self._qdepth[w] > 0:
+                self._qdepth[w] -= 1
             self._inflight -= 1
             if self._inflight == 0:
                 self._cv.notify_all()

@@ -40,6 +40,7 @@ from typing import Callable, Iterator, Optional, Tuple
 import numpy as np
 
 from zipkin_tpu import faults, obs
+from zipkin_tpu.obs import critpath
 
 logger = logging.getLogger(__name__)
 
@@ -108,10 +109,21 @@ class WriteAheadLog:
         fh.write(payload)
         fh.flush()
         faults.crashpoint("wal.append.pre_fsync")
+        t1 = time.perf_counter()
+        # the critical-path ledger wants append and fsync as DISJOINT
+        # intervals (the recorder's wal_append stage keeps including the
+        # fsync): a no-op unless a traced MP payload is being flushed on
+        # this thread
+        critpath.stamp_active(
+            critpath.SEG_WAL_APPEND, int(t0 * 1e9), int(t1 * 1e9)
+        )
         if self.fsync:
-            t1 = time.perf_counter()
             os.fsync(fh.fileno())
-            obs.record("wal_fsync", time.perf_counter() - t1)
+            t2 = time.perf_counter()
+            obs.record("wal_fsync", t2 - t1)
+            critpath.stamp_active(
+                critpath.SEG_WAL_FSYNC, int(t1 * 1e9), int(t2 * 1e9)
+            )
         # bit-rot injection site (ISSUE 7): the record's payload bytes
         # are durable — damage them at rest; the process keeps running
         faults.corrupt_point(
