@@ -101,12 +101,21 @@ def main() -> None:
     # store (ISSUE 7 — benchmarks/scrub_overhead.py owns it);
     # "fanout": wire-to-ack matrix over the parse fan-out tier —
     # workers x format x transport with per-stage decomposition and the
-    # 429 onset probe (benchmarks/ingest_fanout.py owns it, INGEST_r07).
+    # 429 onset probe (benchmarks/ingest_fanout.py owns it, INGEST_r07);
+    # "query_concurrency": the query-SLO harness with the >=8-thread
+    # concurrent-read leg — queries/sec, p99, and the lock_wait vs
+    # device vs transfer split from the query-plane observatory
+    # (ISSUE 12 — benchmarks/query_slo.py owns it, QUERY_SLO_r07).
     mode = os.environ.get("BENCH_MODE", "json")
     if mode == "obs":
         from benchmarks.obs_overhead import main as obs_main
 
         obs_main()
+        return
+    if mode == "query_concurrency":
+        from benchmarks.query_slo import main as query_slo_main
+
+        query_slo_main()
         return
     if mode == "scrub":
         from benchmarks.scrub_overhead import main as scrub_main

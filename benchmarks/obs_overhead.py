@@ -32,6 +32,11 @@ this leg drives the FULL sink through the MP tier (workers=1) with
 ``TPU_OBS_CRITPATH`` flipped. Same < 2% bar: a stamp is a handful of
 seqlocked word stores, and the stitcher runs on the ticker thread.
 
+ISSUE 12 adds a fifth A/B over the query-plane observatory: the
+instrumented aggregator lock measures every fused-ingest acquire, so
+the FULL sink (workers=0) with ``obs_query_enabled`` flipped isolates
+the lock wrapper + trace-hook cost. Same < 2% bar.
+
 Run from the repo root: ``python -m benchmarks.obs_overhead``
 (OBS_BENCH_SPANS, OBS_BENCH_PORT) or ``BENCH_MODE=obs python bench.py``.
 """
@@ -167,6 +172,31 @@ async def run() -> dict:
     critpath_pct = (critpath_best["off"] - critpath_best["on"]) \
         / critpath_best["off"] * 100.0
 
+    # -- query-observatory A/B (ISSUE 12): the instrumented aggregator
+    # lock rides EVERY fused-ingest acquire (non-blocking fast path,
+    # wait/hold measurement, holder attribution) and the querytrace
+    # begin/finish hooks ride the read entrypoints — so the FULL sink
+    # exercises the lock wrapper on every batch even with no readers.
+    # Shadow and critpath off so the delta isolates the ledger writes.
+    query_best = {"on": 0.0, "off": 0.0}
+    for _ in range(pairs):
+        for label, on in (("on", True), ("off", False)):
+            leg = await _run_leg(
+                "full", "json", port + i, 0, payloads, batch, total,
+                config_overrides={
+                    "obs_windows_enabled": True,
+                    "obs_windows_tick_s": 1.0,
+                    "obs_shadow_enabled": False,
+                    "obs_query_enabled": on,
+                },
+            )
+            i += 1
+            query_best[label] = max(
+                query_best[label], leg["spans_per_sec"]
+            )
+    query_pct = (query_best["off"] - query_best["on"]) \
+        / query_best["off"] * 100.0
+
     # -- steady-state recompile check: a leg that DOES dispatch device
     # programs (the null sink never does), warmed, then counted
     recompiles = await asyncio.to_thread(_steady_state_recompiles)
@@ -188,6 +218,9 @@ async def run() -> dict:
         "critpath_overhead_pct": round(critpath_pct, 3),
         "spans_per_sec_critpath_off": critpath_best["off"],
         "spans_per_sec_critpath_on": critpath_best["on"],
+        "query_observatory_overhead_pct": round(query_pct, 3),
+        "spans_per_sec_query_off": query_best["off"],
+        "spans_per_sec_query_on": query_best["on"],
         "device_recompiles_steady_state": recompiles,
         "spans_per_leg": total,
         "pairs": pairs,

@@ -43,6 +43,116 @@ def _stats(xs):
     }
 
 
+def _concurrent_leg(store, end_ts_ms: int, qs) -> dict:
+    """ISSUE 12 baseline: >=8 reader threads hammering a mixed
+    fresh/cached/dependency workload against the live aggregator lock.
+
+    This is the measurement the ROADMAP item 4 refactor (epoch-published
+    read mirror) must move: with every read serialized behind one RLock,
+    queries/sec flatlines and p99 inflates by lock_wait. The query-plane
+    observatory decomposes the p99 into lock_wait vs device vs transfer
+    from INSIDE the pipeline, and the windowed telemetry plane
+    cross-checks the stitched query count + p99 so the harness and the
+    observatory cannot silently diverge."""
+    import threading
+
+    from zipkin_tpu import obs
+    from zipkin_tpu.obs.windows import WindowedTelemetry
+
+    n_threads = max(8, int(os.environ.get("QUERY_SLO_THREADS", 8)))
+    iters = int(os.environ.get("QUERY_SLO_CONC_ITERS", 12))
+    store.set_query_observatory(True)
+    store.querytrace.reset()
+    obs.RECORDER.reset()  # quiesced: ingest done, reads not yet started
+    windows = WindowedTelemetry(obs.RECORDER, tick_s=1.0)
+
+    walls_ms = [[] for _ in range(n_threads)]
+    barrier = threading.Barrier(n_threads)
+
+    def reader(k: int) -> None:
+        barrier.wait()
+        for j in range(iters):
+            kind = (k + j) % 3
+            t1 = time.perf_counter()
+            if kind == 0:
+                # fresh: drop memoized pulls so the read crosses the
+                # device (dispatch + packed transfer under the lock)
+                store.invalidate_read_cache()
+                store.get_dependencies(end_ts_ms, end_ts_ms).execute()
+            elif kind == 1:
+                # cached: deps answered from the staleness-bounded cache
+                store.get_dependencies(end_ts_ms, end_ts_ms).execute()
+            else:
+                store.latency_quantiles(qs)
+            walls_ms[k].append((time.perf_counter() - t1) * 1e3)
+
+    threads = [
+        threading.Thread(target=reader, args=(k,)) for k in range(n_threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    # stitch BEFORE the tick so the relayed query_wall observations land
+    # inside the tick's delta and the windowed cross-check sees them all
+    store.querytrace.stitch()
+    windows.tick()
+    wf = store.querytrace.waterfall()
+    flat = sorted(w for per in walls_ms for w in per)
+    total = len(flat)
+    p99_ms = flat[min(total - 1, int(0.99 * (total - 1) + 0.5))]
+    segs = {s["name"]: s["sumUs"] for s in wf["segments"]}
+    lock_wait_us = segs.get("lock_wait", 0)
+    device_us = segs.get("device_dispatch", 0) + segs.get("device_wall", 0)
+    transfer_us = segs.get("readpack_transfer", 0) + segs.get("unpack", 0)
+    attributed = max(1, sum(segs.values()))
+
+    win_wall = windows.window(3600.0).stage("query_wall")
+    win_p99_ms = win_wall.p99_us / 1e3
+    lock = wf["lock"]
+    return {
+        "threads": n_threads,
+        "queries": total,
+        "queries_per_sec": round(total / elapsed, 1),
+        "wall_ms": _stats(flat),
+        "p99_ms": round(p99_ms, 2),
+        "conservation_p50": wf["conservation"]["p50"],
+        # where the concurrent p99 actually goes: serialized waiting on
+        # the aggregator lock vs device program time vs the packed pull
+        "split_us": {
+            "lock_wait": lock_wait_us,
+            "device": device_us,
+            "transfer": transfer_us,
+            "other": attributed - lock_wait_us - device_us - transfer_us,
+        },
+        "split_fraction": {
+            "lock_wait": round(lock_wait_us / attributed, 4),
+            "device": round(device_us / attributed, 4),
+            "transfer": round(transfer_us / attributed, 4),
+        },
+        "lock": {
+            "acquisitions": lock["queryLockAcquisitions"],
+            "contended": lock["queryLockContended"],
+            "waiters_high_water": lock["queryLockWaitersHighWater"],
+            "wait_p99_us": lock["queryLockWaitP99Us"],
+            "hold_p99_us": lock["queryLockHoldP99Us"],
+        },
+        # windowed-plane cross-check: the stitcher relays every folded
+        # wall into query_wall, so the plane must see exactly the
+        # harness's query count, and its (log2-bucketed) p99 must track
+        # the harness p99
+        "windowed_query_wall_count": win_wall.count,
+        "windowed_query_wall_p99_ms": round(win_p99_ms, 3),
+        "windowed_count_matches": bool(win_wall.count == total),
+        "windowed_p99_agrees": bool(
+            total > 0 and 0.25 * p99_ms <= win_p99_ms <= 2.5 * p99_ms
+        ),
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -218,6 +328,9 @@ def main() -> None:
         win_fresh.count >= reps and 0.25 * wall_p99 <= win_p99 <= 1.25 * wall_p99
     )
 
+    # -- concurrent-read baseline (ISSUE 12) ------------------------------
+    concurrent = _concurrent_leg(store, end_ts_ms, qs)
+
     # -- legacy (3-pull) vs packed (1-pull) dependency-edge A/B ----------
     # The raw (pre-pack) program still compiles; pulling its three
     # arrays separately is exactly the pre-change read path. Parity must
@@ -359,6 +472,7 @@ def main() -> None:
         "reads_transfers_per_query": transfers,
         "reads_wall_over_device": wall_over_device,
         "flight_recorder": recorder_report,
+        "concurrent": concurrent,
         "dependency_edges_transfer_ab": edges_ab,
         "program_device_ms_per_dispatch": program_ms,
         "incremental_ctx": ctx_report,

@@ -252,6 +252,53 @@ def test_zt04_quiet_when_all_writes_guarded(tmp_path):
     assert rules(result) == []
 
 
+def test_zt04_recognizes_instrumented_rlock(tmp_path):
+    # the contention-ledger lock (obs/querytrace.py, ISSUE 12) is a
+    # drop-in RLock; swapping it in must not blind the discipline check
+    assert_rule_owned(
+        tmp_path,
+        """
+        from zipkin_tpu.obs import querytrace
+
+        class Agg:
+            def __init__(self):
+                self.lock = querytrace.InstrumentedRLock(name="agg")
+                self.tables = {}
+
+            def ingest(self, k, v):
+                with self.lock:
+                    self.tables[k] = v
+
+            def clear(self):
+                self.tables = {}
+        """,
+        "ZT04",
+    )
+
+
+def test_zt04_quiet_for_guarded_instrumented_rlock(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        from zipkin_tpu.obs import querytrace
+
+        class Agg:
+            def __init__(self):
+                self.lock = querytrace.InstrumentedRLock(name="agg")
+                self.tables = {}
+
+            def ingest(self, k, v):
+                with self.lock:
+                    self.tables[k] = v
+
+            def clear(self):
+                with self.lock:
+                    self.tables = {}
+        """,
+    )
+    assert rules(result) == []
+
+
 # -- ZT05: donation misuse ----------------------------------------------
 
 
@@ -698,6 +745,73 @@ def test_zt08_clean_host_side_windows_device_hooks(tmp_path):
             fn = OBSERVATORY.wrap("spmd_step", kernel)
             WINDOWS.tick_if_due()
             return fn
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt08_flags_querytrace_stamp_inside_jitted_def(tmp_path):
+    # query-observatory stamps are thread-local host mutation: a traced
+    # region would bake one trace-time interval forever
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import querytrace
+
+        @jax.jit
+        def kernel(x):
+            querytrace.stamp_active(querytrace.QSEG_UNPACK, 0, 1)
+            return x
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_flags_querytrace_begin_reachable_from_traced_code(tmp_path):
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import querytrace
+
+        QUERYTRACE = querytrace.QueryObservatory()
+
+        def _arm(x):
+            QUERYTRACE.begin("dependencies")
+            return x
+
+        def kernel(x):
+            return _arm(x)
+
+        run = jax.jit(kernel)
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_clean_host_side_querytrace_hooks(tmp_path):
+    # arming/stitching/lock-wrapping from plain host code is the
+    # intended use — only traced reachability is the violation
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import querytrace
+
+        QUERYTRACE = querytrace.QueryObservatory()
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def read():
+            tr = QUERYTRACE.begin("quantiles")
+            try:
+                return kernel(1)
+            finally:
+                QUERYTRACE.finish(tr)
+                QUERYTRACE.stitch()
         """,
     )
     assert rules(result) == []

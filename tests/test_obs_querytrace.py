@@ -1,0 +1,533 @@
+"""Query-plane observatory (ISSUE 12): the instrumented aggregator
+lock's contention ledger (threaded fuzz: waiter-count accuracy, wait+hold
+conservation against wall time, RLock re-entrancy), the per-query
+critical-path fold (interval clipping, gap sweep, conservation), the
+live-read conservation property against a real store, the
+query_lock_wait SLO trip/clear, incident capture on trip, and the
+cached-read staleness gauges."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from zipkin_tpu.obs import querytrace
+from zipkin_tpu.obs.incidents import IncidentRecorder
+from zipkin_tpu.obs.querytrace import (
+    InstrumentedRLock,
+    QueryObservatory,
+    QueryTrace,
+)
+from zipkin_tpu.obs.recorder import StageRecorder
+from zipkin_tpu.obs.slo import SloSpec, SloWatchdog
+from zipkin_tpu.obs.windows import WindowedTelemetry
+
+
+# -- lock ledger: single-thread semantics --------------------------------
+
+
+def test_lock_uncontended_and_reentrant():
+    lk = InstrumentedRLock(name="t", recorder=StageRecorder(), enabled=True)
+    with lk:
+        with lk:  # re-entrant: counted, never measured
+            pass
+    c = lk.counters()
+    assert c["queryLockAcquisitions"] == 1
+    assert c["queryLockReentries"] == 1
+    assert c["queryLockContended"] == 0
+    assert c["queryLockWaiters"] == 0
+    # uncontended fast path: the wait histogram records a zero-bucket
+    # observation (the SLO needs the full distribution, zeros included)
+    assert sum(lk.counters()["queryLock"]["waitHist"]) == 1
+    # hold was measured
+    assert sum(lk.counters()["queryLock"]["holdHist"]) == 1
+    # the lock is actually released: a second holder gets through
+    with lk:
+        pass
+    assert lk.counters()["queryLockAcquisitions"] == 2
+
+
+def test_lock_disabled_skips_ledger_but_still_locks():
+    lk = InstrumentedRLock(name="t", enabled=False)
+    with lk:
+        pass
+    c = lk.counters()
+    assert c["queryLockAcquisitions"] == 1
+    assert sum(c["queryLock"]["waitHist"]) == 0
+    assert sum(c["queryLock"]["holdHist"]) == 0
+
+
+def test_lock_wait_relayed_into_recorder_stage():
+    rec = StageRecorder()
+    lk = InstrumentedRLock(name="t", recorder=rec, enabled=True)
+    with lk:
+        pass
+    st = rec.snapshot().stage("query_lock_wait")
+    assert st.count == 1
+
+
+def test_lock_holder_attribution_and_relabel():
+    lk = InstrumentedRLock(name="t", recorder=StageRecorder(), enabled=True)
+    with querytrace.lock_label("ingest_fused"):
+        with lk:
+            pass
+    with lk:
+        lk.relabel("rollup")
+        with lk:
+            # nested relabel is a no-op: the outer attribution wins
+            lk.relabel("inner")
+    holders = lk.counters()["queryLock"]["holders"]
+    assert holders["ingest_fused"]["count"] == 1
+    assert holders["rollup"]["count"] == 1
+    assert "inner" not in holders
+    # off-thread label context restored
+    assert querytrace.current_label() == "unattributed"
+
+
+# -- lock ledger: contention ---------------------------------------------
+
+
+def test_lock_contention_waiter_depth_and_wait_accounting():
+    lk = InstrumentedRLock(name="t", recorder=StageRecorder(), enabled=True)
+    holding = threading.Event()
+    release = threading.Event()
+    done = threading.Event()
+
+    def holder():
+        with lk:
+            holding.set()
+            release.wait(5.0)
+
+    def waiter():
+        with lk:
+            pass
+        done.set()
+
+    th = threading.Thread(target=holder)
+    tw = threading.Thread(target=waiter)
+    th.start()
+    assert holding.wait(5.0)
+    tw.start()
+    # live waiter depth becomes visible while tw blocks
+    deadline = time.monotonic() + 5.0
+    while lk.waiters < 1 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert lk.waiters == 1
+    assert lk.waiters_high_water >= 1
+    time.sleep(0.02)  # measurable wait
+    release.set()
+    assert done.wait(5.0)
+    th.join(5.0)
+    tw.join(5.0)
+    c = lk.counters()
+    assert c["queryLockContended"] == 1
+    assert c["queryLockWaiters"] == 0
+    assert c["queryLockWaitMaxUs"] >= 10_000  # slept 20 ms while held
+    assert c["queryLockHoldMaxUs"] >= 10_000  # holder held that long
+
+
+def test_lock_threaded_fuzz_conservation():
+    """N threads x M acquires: exact acquisition accounting, histogram
+    totals match, wait+hold sums stay inside wall-clock bounds, and the
+    waiter gauge returns to zero."""
+    lk = InstrumentedRLock(name="t", recorder=StageRecorder(), enabled=True)
+    n_threads, m = 6, 200
+    shared = [0]
+    t0 = time.perf_counter()
+
+    def worker(i):
+        for k in range(m):
+            with lk:
+                shared[0] += 1
+                if k % 64 == 0:
+                    with lk:  # exercise re-entrancy under contention
+                        shared[0] += 0
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    elapsed_us = (time.perf_counter() - t0) * 1e6
+    assert shared[0] == n_threads * m
+    c = lk.counters()
+    assert c["queryLockAcquisitions"] == n_threads * m
+    assert c["queryLockReentries"] == n_threads * ((m + 63) // 64)
+    assert c["queryLockWaiters"] == 0
+    assert c["queryLockWaitersHighWater"] <= n_threads - 1
+    table = c["queryLock"]
+    assert sum(table["waitHist"]) == n_threads * m
+    assert sum(table["holdHist"]) == n_threads * m
+    # conservation: holds serialize, so total hold time is bounded by
+    # the test wall; each thread's wait is bounded by the wall too
+    assert c["queryLockHoldSumUs"] <= elapsed_us * 1.25
+    assert c["queryLockWaitSumUs"] <= elapsed_us * n_threads
+    # every hold is attributed somewhere
+    assert sum(r["count"] for r in table["holders"].values()) == n_threads * m
+
+
+def test_lock_reset_counters_preserves_live_depth():
+    lk = InstrumentedRLock(name="t", recorder=StageRecorder(), enabled=True)
+    with lk:
+        pass
+    lk.reset_counters()
+    c = lk.counters()
+    assert c["queryLockAcquisitions"] == 0
+    assert sum(c["queryLock"]["waitHist"]) == 0
+    assert c["queryLockWaiters"] == 0
+
+
+# -- trace fold: clipping, gap sweep, conservation -----------------------
+
+
+def _fold_synthetic(ivs, wall_ns):
+    obs = QueryObservatory(recorder=StageRecorder(), enabled=True)
+    tr = QueryTrace("synthetic")
+    tr.t0_ns = 1_000_000
+    tr.wall_ns = wall_ns
+    tr.ivs = [(code, tr.t0_ns + a, tr.t0_ns + b) for code, a, b in ivs]
+    return obs._fold(tr)
+
+
+def test_fold_gap_sweep_conserves_wall():
+    # two disjoint stamped segments inside a 1 ms wall: the sweep
+    # attributes exactly the gaps to "other" and conservation is 1.0
+    f = _fold_synthetic(
+        [
+            (querytrace.QSEG_CACHE_PROBE, 0, 100_000),
+            (querytrace.QSEG_SERIALIZE, 600_000, 900_000),
+        ],
+        1_000_000,
+    )
+    durs = f["durs_ns"]
+    assert durs[querytrace.QSEG_CACHE_PROBE] == 100_000
+    assert durs[querytrace.QSEG_SERIALIZE] == 300_000
+    assert durs[querytrace.QSEG_OTHER] == 600_000
+    assert f["conservation"] == pytest.approx(1.0)
+
+
+def test_fold_clips_out_of_wall_intervals():
+    # a segment straddling the finish instant is clipped to the wall;
+    # one entirely outside vanishes
+    f = _fold_synthetic(
+        [
+            (querytrace.QSEG_UNPACK, 900_000, 1_500_000),
+            (querytrace.QSEG_DEVICE_WALL, 2_000_000, 3_000_000),
+        ],
+        1_000_000,
+    )
+    durs = f["durs_ns"]
+    assert durs[querytrace.QSEG_UNPACK] == 100_000
+    assert durs[querytrace.QSEG_DEVICE_WALL] == 0
+    assert durs[querytrace.QSEG_OTHER] == 900_000
+    assert f["conservation"] == pytest.approx(1.0)
+
+
+def test_fold_overlapping_stamps_overcount_but_sweep_stays_sane():
+    # overlap (lock wait inside a device dispatch) double-counts segment
+    # time, so conservation can exceed 1 — the sweep must not also add
+    # phantom "other" time underneath the overlap
+    f = _fold_synthetic(
+        [
+            (querytrace.QSEG_DEVICE_DISPATCH, 0, 800_000),
+            (querytrace.QSEG_LOCK_WAIT, 200_000, 400_000),
+        ],
+        1_000_000,
+    )
+    durs = f["durs_ns"]
+    assert durs[querytrace.QSEG_OTHER] == 200_000  # only the tail gap
+    assert f["conservation"] == pytest.approx(1.2)
+
+
+def test_begin_finish_lifecycle_and_nesting():
+    obs = QueryObservatory(recorder=StageRecorder(), enabled=True)
+    tr = obs.begin("dependencies")
+    assert tr is not None and querytrace.active() is tr
+    assert obs.begin("nested") is None  # enclosing query owns the thread
+    querytrace.stamp_active(querytrace.QSEG_CACHE_PROBE,
+                            tr.t0_ns, tr.t0_ns + 10)
+    obs.finish(tr)
+    assert querytrace.active() is None
+    obs.finish(None)  # disabled-path no-op
+    assert obs.stitch() == 1
+    c = obs.counters()
+    assert c["queryTraces"] == 1
+    assert c["queryWallP50Us"] >= 0
+    disabled = QueryObservatory(enabled=False)
+    assert disabled.begin("x") is None
+
+
+def test_stitch_emits_slowest_query_spans():
+    obs = QueryObservatory(recorder=StageRecorder(), enabled=True)
+
+    class FakeEmitter:
+        def __init__(self):
+            self.spans = []
+
+        def emit_spans(self, spans):
+            self.spans.extend(spans)
+
+    obs.emitter = FakeEmitter()
+    for name in ("fast", "slow"):
+        tr = obs.begin(name)
+        querytrace.stamp_active(querytrace.QSEG_SERIALIZE,
+                                tr.t0_ns, tr.t0_ns + 500)
+        if name == "slow":
+            time.sleep(0.002)
+        obs.finish(tr)
+    assert obs.stitch() == 2
+    names = [s.name for s in obs.emitter.spans]
+    assert "query_slow" in names          # root span of the slowest
+    assert "serialize" in names           # child segment span
+    root = next(s for s in obs.emitter.spans if s.name == "query_slow")
+    assert root.tags["obs.querytrace.kind"] == "slow"
+    assert float(root.tags["obs.querytrace.conservation"]) > 0
+
+
+# -- live-read conservation property (the tier-1 acceptance check) -------
+
+
+@pytest.fixture(scope="module")
+def small_store():
+    from zipkin_tpu.parallel.mesh import make_mesh
+    from zipkin_tpu.tpu.state import AggConfig
+    from zipkin_tpu.tpu.store import TpuStorage
+
+    cfg = AggConfig(
+        max_services=64, max_keys=256, hll_precision=8,
+        digest_centroids=16, digest_buffer=1 << 16,
+        ring_capacity=1 << 16, link_buckets=4, hist_slices=2,
+    )
+    store = TpuStorage(config=cfg, mesh=make_mesh(1), pad_to_multiple=256)
+    now_ms = int(time.time() * 1000)
+    spans = [
+        {
+            "traceId": f"{i + 1:032x}", "id": f"{i + 1:016x}",
+            "name": "op%d" % (i % 3),
+            "timestamp": (now_ms - 1000) * 1000, "duration": 1000 + i,
+            "localEndpoint": {"serviceName": "svc%d" % (i % 4)},
+        }
+        for i in range(300)
+    ]
+    store.ingest_json_fast(json.dumps(spans).encode())
+    yield store, now_ms
+    store.close()
+
+
+def test_live_read_conservation_property(small_store):
+    """Against real reads — fresh device pulls, cache hits, dependency
+    link resolution, quantile serialization — the stitched timelines
+    must conserve: segments + attributed gaps cover the measured wall
+    within 10% at p50 (the fold's gap sweep makes the lower bound
+    structural; the upper bound catches double-stamped overlap)."""
+    store, now_ms = small_store
+    store.set_query_observatory(True)
+    store.querytrace.reset()
+    store.invalidate_read_cache()
+    for rep in range(3):
+        store.get_dependencies(now_ms, 3_600_000).execute()
+        store.latency_quantiles([0.5, 0.99], end_ts=now_ms,
+                                lookback=3_600_000)
+        store.trace_cardinalities()
+        store.sketch_overview([0.5])
+    assert store.querytrace.stitch() == 12
+    wf = store.querytrace.waterfall()
+    assert 0.90 <= wf["conservation"]["p50"] <= 1.10
+    seg_names = {s["name"] for s in wf["segments"]}
+    assert "cache_probe" in seg_names
+    # fresh reads crossed the device: dispatch + the packed transfer
+    assert "device_dispatch" in seg_names
+    assert "readpack_transfer" in seg_names
+    # the ledger saw the reads and attributes them by query name
+    holders = wf["lock"]["holders"]
+    assert any(h.startswith("query:") for h in holders)
+    counters = store.querytrace.counters()
+    assert counters["queryLockAcquisitions"] > 0
+    assert counters["queryTraces"] == 12
+
+
+def test_query_wall_feeds_windowed_plane(small_store):
+    """The stitcher relays each folded wall into the query_wall stage,
+    so the windowed telemetry plane (and therefore the SLO watchdog)
+    sees exactly the stitched queries — the cross-check the benchmark
+    harness also asserts."""
+    from zipkin_tpu import obs as obs_mod
+
+    store, now_ms = small_store
+    store.set_query_observatory(True)
+    store.querytrace.reset()
+    obs_mod.RECORDER.reset()
+    win = WindowedTelemetry(
+        obs_mod.RECORDER, store.ingest_counters, tick_s=1.0)
+    win.on_tick(store.querytrace.on_tick)
+    store.invalidate_read_cache()
+    store.get_dependencies(now_ms, 3_600_000).execute()
+    store.trace_cardinalities()
+    # tick 1 stitches (on_tick runs after the snapshot), tick 2's delta
+    # captures the relayed query_wall observations — the <=1-tick lag the
+    # production wiring (querytrace before watchdog) documents
+    win.tick()
+    win.tick()
+    w = win.window(60.0)
+    assert w.stage("query_wall").count == 2
+    assert w.stage("query_lock_wait").count >= 2
+
+
+def test_read_cache_staleness_gauges(small_store):
+    store, now_ms = small_store
+    store.invalidate_read_cache()
+    store._read_cache_age_ms = 0.0
+    store.trace_cardinalities()          # fresh: caches the answer
+    time.sleep(0.01)
+    store.trace_cardinalities()          # hit: records age-at-serve
+    c = store.ingest_counters()
+    assert c["readCacheEntries"] >= 1
+    assert c["readCacheServeAgeMs"] >= 10.0
+    assert c["readCacheServeAgeMaxMs"] >= c["readCacheServeAgeMs"]
+
+
+def test_clear_reapplies_observatory_to_fresh_aggregator(small_store):
+    store, _ = small_store
+    store.set_query_observatory(True)
+    store.clear()
+    lk = store.agg.lock
+    assert isinstance(lk, InstrumentedRLock)
+    assert lk.enabled            # remembered enablement reapplied
+    assert store.querytrace.counters()["queryTraces"] == 0
+    # lock_provider follows the swap: ledger reads hit the NEW lock
+    with lk:
+        pass
+    assert store.querytrace.counters()["queryLockAcquisitions"] == 1
+
+
+# -- query_lock_wait SLO trip/clear --------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class Harness:
+    def __init__(self, specs):
+        self.rec = StageRecorder()
+        self.vals = {}
+        self.clock = FakeClock()
+        self.win = WindowedTelemetry(
+            self.rec, lambda: dict(self.vals),
+            tick_s=1.0, slots=16, coarse_slots=4, coarse_factor=16,
+            clock=self.clock,
+        )
+        self.dog = SloWatchdog(self.win, specs)
+
+    def tick(self, n=1):
+        for _ in range(n):
+            self.clock.advance(1.0)
+            self.win.tick(self.clock())
+
+    def verdict(self, name):
+        return next(v for v in self.dog.verdicts() if v["name"] == name)
+
+
+QUERY_LOCK_SPEC = SloSpec(
+    "query_lock_wait", "latency", short_s=4, long_s=8, burn_threshold=2.0,
+    objective=0.99, stage="query_lock_wait", threshold_us=10_000,
+)
+
+
+def test_query_lock_wait_slo_trips_and_clears():
+    """The instrumented lock relays every outermost wait (zeros
+    included) into query_lock_wait; the default-shaped spec must trip on
+    sustained contention and clear when readers stop queueing."""
+    h = Harness([QUERY_LOCK_SPEC])
+    # healthy: uncontended acquires, waits ~0
+    for _ in range(4):
+        for _ in range(20):
+            h.rec.record_relayed("query_lock_wait", 0.0)
+        h.tick()
+    assert not h.verdict("query_lock_wait")["alert"]
+    # contention: half the acquires wait 50 ms behind ingest holds
+    # (bad frac 0.5, budget 0.01 -> burn 50 on both windows)
+    for _ in range(8):
+        for _ in range(10):
+            h.rec.record_relayed("query_lock_wait", 0.0)
+            h.rec.record_relayed("query_lock_wait", 0.05)
+        h.tick()
+    v = h.verdict("query_lock_wait")
+    assert v["alert"]
+    assert v["windows"]["4s"]["burn"] >= 2.0
+    assert h.dog.trips == 1
+    # recovery: contention ages out of both windows
+    for _ in range(9):
+        for _ in range(20):
+            h.rec.record_relayed("query_lock_wait", 0.0)
+        h.tick()
+    assert not h.verdict("query_lock_wait")["alert"]
+    assert h.dog.clears == 1
+
+
+def test_on_trip_hook_fires_once_per_transition():
+    h = Harness([QUERY_LOCK_SPEC])
+    fired = []
+    h.dog.on_trip.append(lambda name, v: fired.append(name))
+    for _ in range(8):
+        for _ in range(10):
+            h.rec.record_relayed("query_lock_wait", 0.05)
+        h.tick()
+    assert fired == ["query_lock_wait"]  # held alert does not re-fire
+    # a failing hook must not break evaluation
+    h.dog.on_trip.append(lambda name, v: 1 / 0)
+    for _ in range(9):
+        for _ in range(20):
+            h.rec.record_relayed("query_lock_wait", 0.0)
+        h.tick()
+    assert h.dog.clears == 1
+
+
+# -- incident capture -----------------------------------------------------
+
+
+def test_slo_trip_captures_incident_bundle(tmp_path):
+    """SLO trip -> on_trip hook -> bundle on disk with every registered
+    source snapshotted (a failing source degrades to an error note)."""
+    h = Harness([QUERY_LOCK_SPEC])
+    rec = IncidentRecorder(str(tmp_path / "incidents"), retention=4)
+    rec.add_source("slo", h.dog.status)
+    rec.add_source("windows", h.win.status)
+    rec.add_source("broken", lambda: 1 / 0)
+    h.dog.on_trip.append(rec.on_slo_trip)
+    for _ in range(8):
+        for _ in range(10):
+            h.rec.record_relayed("query_lock_wait", 0.05)
+        h.tick()
+    paths = rec.bundles()
+    assert len(paths) == 1
+    bundle = json.loads(open(paths[0]).read())
+    assert bundle["trigger"]["kind"] == "slo_trip"
+    assert bundle["trigger"]["name"] == "query_lock_wait"
+    assert bundle["trigger"]["verdict"]["alert"] is True
+    assert bundle["slo"]["alerting"] is True
+    assert "windows" in bundle
+    assert "error" in bundle["broken"]
+    assert rec.counters()["incidentsCaptured"] == 1
+
+
+def test_incident_retention_bounds_disk(tmp_path):
+    rec = IncidentRecorder(str(tmp_path), retention=3,
+                           sources={"x": lambda: {"ok": True}})
+    for i in range(7):
+        assert rec.capture({"kind": "manual", "name": f"t{i}"}) is not None
+    paths = rec.bundles()
+    assert len(paths) == 3
+    # newest kept: capture-order counter in the name makes this exact
+    assert paths[-1].endswith("-t6.json")
+    assert rec.counters()["incidentsCaptured"] == 7

@@ -747,3 +747,141 @@ class TestAccuracyObservatory:
                 await server.stop()
 
         asyncio.run(wrapper())
+
+
+# -- query-plane observatory surfaces (ISSUE 12) -------------------------
+
+
+class TestQueryObservatoryPlane:
+    def test_statusz_queries_section_and_prometheus_families(self):
+        """Reads through the HTTP boundary arm real query traces; the
+        statusz queries section, the zipkin_tpu_query_lock_* /
+        zipkin_tpu_query_segment_* families, and the /metrics gauges all
+        report them."""
+        async def scenario(client):
+            resp = await client.post(
+                "/api/v2/spans", data=json_v2.encode_span_list(TRACE),
+                headers={"Content-Type": "application/json"},
+            )
+            assert resp.status == 202
+            # drive the traced read entrypoints: dependencies (device
+            # pull + link resolve) and percentiles (serialize)
+            resp = await client.get(
+                f"/api/v2/dependencies?endTs={QUERY_TS}&lookback={DAY_MS}"
+            )
+            assert resp.status == 200
+            resp = await client.get("/api/v2/tpu/percentiles?q=0.5,0.99")
+            assert resp.status == 200
+
+            body = await (await client.get("/api/v2/tpu/statusz")).json()
+            q = body["queries"]
+            assert q["enabled"] is True
+            assert q["queries"] >= 2  # waterfall() stitched the reads
+            assert 0.5 <= q["conservation"]["p50"] <= 1.5
+            segs = {s["name"]: s for s in q["segments"]}
+            assert "cache_probe" in segs
+            assert segs["cache_probe"]["kind"] == "service"
+            assert q["wall"]["p99Us"] >= q["wall"]["p50Us"]
+            ws = q["waitVsService"]
+            assert ws["serviceUs"] > 0
+            assert 0.0 <= ws["waitFraction"] <= 1.0
+            assert q["slowest"]["wallUs"] > 0
+            lock = q["lock"]
+            assert lock["name"] == "agg"
+            assert lock["queryLockAcquisitions"] > 0
+            assert any(h.startswith("query:") for h in lock["holders"])
+            # ingest attribution landed too (the POST above held the lock)
+            assert "ingest_fused" in lock["holders"]
+
+            text = await (await client.get("/prometheus")).text()
+            _assert_valid_prometheus(text)
+            assert "# TYPE zipkin_tpu_query_lock_wait_seconds histogram" \
+                in text
+            assert "# TYPE zipkin_tpu_query_lock_hold_seconds histogram" \
+                in text
+            assert "zipkin_tpu_query_lock_wait_seconds_count " in text
+            assert re.search(
+                r'zipkin_tpu_query_lock_holds_total\{holder="query:\w+"\} ',
+                text)
+            assert re.search(
+                r'zipkin_tpu_query_segment_count_total\{segment='
+                r'"cache_probe",kind="service"\} ', text)
+            assert "zipkin_tpu_query_lock_acquisitions " in text
+            assert "zipkin_tpu_query_traces " in text
+            assert "zipkin_tpu_read_cache_serve_age_ms " in text
+
+            metrics = await (await client.get("/metrics")).json()
+            assert metrics["gauge.zipkin_tpu.queryTraces"] >= 2
+            assert "gauge.zipkin_tpu.queryLockAcquisitions" in metrics
+            assert "gauge.zipkin_tpu.queryWallP99Us" in metrics
+            assert "gauge.zipkin_tpu.readCacheServeAgeMs" in metrics
+
+        run(scenario)
+
+    def test_query_observatory_disabled_by_config(self):
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=2)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    obs_query_enabled=False,
+                ),
+                storage=storage,
+            )
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                resp = await client.get(
+                    f"/api/v2/dependencies?endTs={QUERY_TS}"
+                    f"&lookback={DAY_MS}"
+                )
+                assert resp.status == 200
+                body = await (
+                    await client.get("/api/v2/tpu/statusz")
+                ).json()
+                assert body["queries"]["enabled"] is False
+                assert body["queries"]["queries"] == 0  # begin() disarmed
+            finally:
+                await client.close()
+                await server.stop()
+
+        asyncio.run(wrapper())
+
+    def test_incident_recorder_wired_by_config(self, tmp_path):
+        async def wrapper():
+            storage = TpuStorage(config=SMALL, num_devices=2)
+            server = ZipkinServer(
+                ServerConfig(
+                    default_lookback=DAY_MS, storage_type="tpu",
+                    obs_incident_dir=str(tmp_path / "incidents"),
+                    obs_incident_retention=4,
+                ),
+                storage=storage,
+            )
+            rec = server._obs_incidents
+            assert rec is not None
+            assert rec.retention == 4
+            assert rec.on_slo_trip in server._obs_slo.on_trip
+            assert {"slo", "windows", "stages", "slowRing",
+                    "counters", "queries"} <= set(rec.sources)
+            client = TestClient(TestServer(server.make_app()))
+            await client.start_server()
+            try:
+                body = await (
+                    await client.get("/api/v2/tpu/statusz")
+                ).json()
+                assert body["incidents"]["incidentsCaptured"] == 0
+                assert body["incidents"]["incidentRetention"] == 4
+                # a manual capture snapshots every wired source
+                path = rec.capture({"kind": "manual", "name": "probe"})
+                assert path is not None
+                import json as _json
+                bundle = _json.loads(open(path).read())
+                assert bundle["queries"]["enabled"] is True
+                assert "specs" in bundle["slo"]
+                assert "lookbacks" in bundle["windows"]
+            finally:
+                await client.close()
+                await server.stop()
+
+        asyncio.run(wrapper())

@@ -32,7 +32,11 @@ from zipkin_tpu.lint.core import Checker, Module, register
 from zipkin_tpu.lint.taint import _root_name
 
 _FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
-_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# InstrumentedRLock (obs/querytrace.py) is a drop-in RLock with a
+# contention ledger — the aggregator's with-discipline must survive the
+# swap, so ZT04 recognizes it as a lock constructor too.
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore", "InstrumentedRLock"}
 
 
 def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
@@ -52,6 +56,7 @@ def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
             "threading",
             "multiprocessing",
             "mp",
+            "querytrace",
         ):
             continue
         for t in node.targets:

@@ -67,15 +67,21 @@ _HOOK_ATTRS = {
     # one trace-time interval forever (or fail under tracing).
     "stamp", "stamp_active", "alloc", "ack", "abandon", "release",
     "stitch", "calibrate", "set_active", "clear_active",
+    # query-plane observatory (ISSUE 12): trace arming is thread-local
+    # state, the instrumented-lock wrapper measures perf_counter waits,
+    # and the stitcher folds under a lock — all host-only. A traced
+    # region would bake one trace-time interval (or fail under tracing).
+    "begin", "finish", "relabel", "lock_label",
 }
 _HOOK_ROOTS = {
     "obs", "WINDOWS", "OBSERVATORY", "obs_device", "SHADOW", "ACCURACY",
     "critpath", "_critpath", "CRITPATH",
+    "querytrace", "_querytrace", "QUERYTRACE",
 }
 _HOOK_MODULES = {
     "zipkin_tpu.obs.windows", "zipkin_tpu.obs.device",
     "zipkin_tpu.obs.shadow", "zipkin_tpu.obs.accuracy",
-    "zipkin_tpu.obs.critpath",
+    "zipkin_tpu.obs.critpath", "zipkin_tpu.obs.querytrace",
 }
 _TRACE_NAMES = {"jit", "shard_map"}
 

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from zipkin_tpu.obs import querytrace
+
 
 class ProgramStats:
     """Counters for one wrapped program build (one jit'd callable)."""
@@ -73,6 +75,15 @@ class ProgramStats:
         t0 = time.perf_counter()
         out = fn(*args, **kw)
         dt = time.perf_counter() - t0
+        # query-plane observatory: when the calling thread carries an
+        # armed QueryTrace (read path only), the enqueue wall of this
+        # program is that query's device_dispatch segment. perf_counter
+        # and perf_counter_ns share a clock, so the ns conversion is
+        # exact enough for the stitcher's gap sweep.
+        querytrace.stamp_active(
+            querytrace.QSEG_DEVICE_DISPATCH,
+            int(t0 * 1e9), int((t0 + dt) * 1e9),
+        )
         self.calls += 1
         self.call_wall_s += dt
         if dt > self.max_call_s:

@@ -119,6 +119,18 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
         # Zeroed on idle ticks, so a stale reading cannot hold an alert.
         SloSpec("ingest_queue_saturation", "gauge",
                 gauge="critpathQueueSaturation", limit=0.9, **kw),
+        # Query-plane observatory (obs/querytrace.py, ISSUE 12): the
+        # instrumented aggregator lock relays every outermost wait into
+        # query_lock_wait — sustained waits past 10 ms mean readers are
+        # serialized behind ingest holds, the contention ROADMAP item
+        # 4's epoch-published mirror must eliminate. query_wall is the
+        # stitched whole-query critical path, so this spec IS the
+        # "p99 < 50 ms under concurrent readers" target measured from
+        # inside the pipeline rather than from a benchmark harness.
+        SloSpec("query_lock_wait", "latency", objective=0.99,
+                stage="query_lock_wait", threshold_us=10_000, **kw),
+        SloSpec("query_p99_concurrent", "latency", objective=0.99,
+                stage="query_wall", threshold_us=50_000, **kw),
     ]
 
 
@@ -136,6 +148,9 @@ class SloWatchdog:
         self._verdicts: List[Dict] = []
         self.trips = 0
         self.clears = 0
+        # on_trip(name, verdict) hooks fire once per alert transition
+        # into the tripped state — incident capture registers here.
+        self.on_trip: List = []
         if subscribe:
             windows.on_tick(lambda _w: self.evaluate())
 
@@ -180,6 +195,7 @@ class SloWatchdog:
     def evaluate(self) -> List[Dict]:
         """Evaluate every spec; returns (and caches) the verdict list."""
         verdicts: List[Dict] = []
+        tripped: List[int] = []  # verdict indexes that transitioned
         with self._lock:
             for spec in self.specs:
                 short = self._burn(spec, self._win.window(spec.short_s))
@@ -191,6 +207,7 @@ class SloWatchdog:
                 now = burning or (was and not calm)
                 if now and not was:
                     self.trips += 1
+                    tripped.append(len(verdicts))
                 elif was and not now:
                     self.clears += 1
                 self._alerts[spec.name] = now
@@ -208,6 +225,15 @@ class SloWatchdog:
                     },
                 })
             self._verdicts = verdicts
+        # Hooks run outside the lock: capture sources read back into the
+        # watchdog (status()) and must not deadlock.
+        for i in tripped:
+            v = verdicts[i]
+            for cb in list(self.on_trip):
+                try:
+                    cb(v["name"], v)
+                except Exception:
+                    pass
         return verdicts
 
     def verdicts(self) -> List[Dict]:

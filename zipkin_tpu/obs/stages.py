@@ -43,6 +43,8 @@ STAGES = (
     "mp_device_feed",    # mp_record substage: fused batch → device ingest feed
     "accuracy_rollup",   # shadow drain + device reads + error estimators
     "wire_to_durable",   # stitched critical path: wire receipt → WAL-durable ack
+    "query_lock_wait",   # outermost wait on the aggregator lock (per acquire)
+    "query_wall",        # stitched query critical path: request begin → result
 )
 
 NUM_STAGES = len(STAGES)
@@ -73,6 +75,8 @@ DEFAULT_BUDGETS_US = {
     "mp_device_feed": 500_000,
     "accuracy_rollup": 1_000_000,
     "wire_to_durable": 5_000_000,
+    "query_lock_wait": 50_000,
+    "query_wall": 150_000,
 }
 
 assert set(DEFAULT_BUDGETS_US) == set(STAGES)

@@ -10,7 +10,6 @@ the 8-virtual-device CPU backend used in CI.
 from __future__ import annotations
 
 import functools
-import threading
 import time
 from typing import Optional, Tuple
 
@@ -25,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zipkin_tpu import obs, readpack
 from zipkin_tpu.obs import device as obs_device
+from zipkin_tpu.obs import querytrace
 from zipkin_tpu.ops import linker as dlink
 from zipkin_tpu.tpu import ingest as ing
 from zipkin_tpu.tpu.columnar import (
@@ -562,7 +562,11 @@ class ShardedAggregator:
         # buffers, so a reader racing a step would touch deleted arrays
         # (or, for the flush-on-read path, silently drop a batch by
         # overwriting the step's result). Reentrant: read paths nest.
-        self.lock = threading.RLock()
+        # Instrumented (ISSUE 12): outermost wait/hold land in the
+        # contention ledger and the query_lock_wait stage — the number
+        # ROADMAP item 4's epoch-published read mirror must drive to
+        # zero. Uncontended acquires take a non-blocking fast path.
+        self.lock = querytrace.InstrumentedRLock(name="agg")
         # Host mirror of the per-shard digest pend_pos (identical on every
         # shard: each advances by the same padded lane count per step).
         # The host dispatches the flush program when the next batch would
@@ -664,6 +668,8 @@ class ShardedAggregator:
             )
         device_batch = jax.device_put(fused, self._sharding)
         with self.lock:
+            # contention-ledger attribution: this hold is the write path
+            self.lock.relabel("ingest_fused")
             # fold due maintenance into ONE fused dispatch with the step
             need_flush = self._pend_lanes + lanes > self.config.digest_buffer
             need_rollup = (
@@ -771,7 +777,21 @@ class ShardedAggregator:
         """THE query-path device→host pull: one counted transfer, then
         zero-copy unpack of the ZPK1 sections (callers hold the lock)."""
         self.read_stats["host_transfers"] += 1
-        return readpack.unpack(readpack.device_get(packed))
+        if querytrace.active() is not None:
+            # device_wall: dispatch-done -> result device-ready, split
+            # out from the transfer below so the per-query waterfall
+            # separates device time from wire time. Only a traced query
+            # pays the extra block (it is free on the CPU backend and
+            # the pull would block identically anyway).
+            t0 = time.perf_counter_ns()
+            # zt-lint: disable=ZT06 — measurement IS the contract: only
+            # a traced query takes this branch, and the pull below would
+            # block identically; the split makes device wall observable
+            packed = jax.block_until_ready(packed)
+            querytrace.stamp_active(
+                querytrace.QSEG_DEVICE_WALL, t0, time.perf_counter_ns()
+            )
+        return readpack.pull(packed)
 
     def merged_sketches(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(hist [K,B], hll [S+1,m], counters) merged over all shards."""

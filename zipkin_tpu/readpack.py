@@ -50,6 +50,7 @@ from typing import List, Sequence, Tuple
 import numpy as np
 
 from zipkin_tpu import obs
+from zipkin_tpu.obs import querytrace
 
 MAGIC = 0x5A504B31  # "ZPK1"
 _SECTION_WORDS = 8
@@ -86,9 +87,11 @@ def device_get(x) -> np.ndarray:
         _transfers += 1
     import jax
 
-    t0 = time.perf_counter()
+    t0 = time.perf_counter_ns()
     out = np.asarray(jax.device_get(x))
-    obs.record("readpack_transfer", time.perf_counter() - t0)
+    t1 = time.perf_counter_ns()
+    obs.record("readpack_transfer", (t1 - t0) / 1e9)
+    querytrace.stamp_active(querytrace.QSEG_READPACK_TRANSFER, t0, t1)
     with _counter_lock:
         _transfer_bytes += out.nbytes
     return out
@@ -208,7 +211,15 @@ def unpack(buf: np.ndarray) -> List[np.ndarray]:
 
 def pull(packed) -> List[np.ndarray]:
     """One transfer + unpack: the host half of a packed query read."""
-    return unpack(device_get(packed))
+    buf = device_get(packed)
+    if querytrace.active() is None:
+        return unpack(buf)
+    t0 = time.perf_counter_ns()
+    out = unpack(buf)
+    querytrace.stamp_active(
+        querytrace.QSEG_UNPACK, t0, time.perf_counter_ns()
+    )
+    return out
 
 
 def describe(buf: np.ndarray) -> List[Tuple[str, tuple, int]]:

@@ -215,6 +215,17 @@ class ZipkinServer:
             and self._obs_emitter is not None
         ):
             self._mp_ingester.critpath.emitter = self._obs_emitter
+        # query-plane observatory (obs/querytrace.py, ISSUE 12): the
+        # store owns the stitcher + the instrumented aggregator lock;
+        # propagate the configured enablement (trace arming and the lock
+        # ledger switch together) and give the slowest-query timeline
+        # the same self-span plane the critpath stitcher rides.
+        _qt_core = getattr(self.storage, "delegate", self.storage)
+        self._querytrace = getattr(_qt_core, "querytrace", None)
+        if hasattr(_qt_core, "set_query_observatory"):
+            _qt_core.set_query_observatory(self.config.obs_query_enabled)
+        if self._querytrace is not None and self._obs_emitter is not None:
+            self._querytrace.emitter = self._obs_emitter
         # windowed telemetry plane + SLO watchdog (ISSUE 9): per-tick
         # delta rings over the recorder/counters, burn-rate evaluation
         # on every tick. The ticker thread follows start()/stop();
@@ -223,6 +234,7 @@ class ZipkinServer:
         self._obs_slo = None
         self._obs_shadow = None
         self._accuracy = None
+        self._obs_incidents = None
         if self.config.obs_windows_enabled:
             from zipkin_tpu.obs.windows import WindowedTelemetry
 
@@ -283,6 +295,12 @@ class ZipkinServer:
                 and getattr(self._mp_ingester, "critpath", None) is not None
             ):
                 self._obs_windows.on_tick(self._mp_ingester.critpath.on_tick)
+            # query stitcher on the same ticker, also BEFORE the
+            # watchdog: each tick folds completed query traces (feeding
+            # the query_wall histogram; query_lock_wait lands directly
+            # from the lock) before burn evaluation reads them.
+            if self._querytrace is not None and self.config.obs_query_enabled:
+                self._obs_windows.on_tick(self._querytrace.on_tick)
             if self.config.obs_slo_enabled:
                 from zipkin_tpu.obs.slo import SloWatchdog, default_specs
 
@@ -294,6 +312,21 @@ class ZipkinServer:
                         burn_threshold=self.config.obs_slo_burn_threshold,
                     ),
                 )
+                # incident capture (obs/incidents.py): every SLO trip
+                # snapshots the volatile planes — slow ring, windowed
+                # percentiles, waterfalls — into a bounded-retention
+                # bundle before the evidence rotates out.
+                if self.config.obs_incident_dir:
+                    from zipkin_tpu.obs.incidents import IncidentRecorder
+
+                    self._obs_incidents = IncidentRecorder(
+                        self.config.obs_incident_dir,
+                        retention=self.config.obs_incident_retention,
+                    )
+                    self._wire_incident_sources(core)
+                    self._obs_slo.on_trip.append(
+                        self._obs_incidents.on_slo_trip
+                    )
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
         self._grpc = None
@@ -815,6 +848,29 @@ class ZipkinServer:
         if w is not None and not w.ticker_running:
             w.tick_if_due()
 
+    def _wire_incident_sources(self, core) -> None:
+        """Register the statusz-equivalent dict builders an incident
+        bundle snapshots. The recorder wraps each source in its own
+        try/except, so a torn plane degrades to an error note inside
+        the bundle instead of losing it."""
+        rec = self._obs_incidents
+        rec.add_source("slo", self._obs_slo.status)
+        rec.add_source("windows", self._obs_windows.status)
+        rec.add_source("stages", lambda: {
+            st.stage: {"count": st.count, "p50Us": st.p50_us,
+                       "p99Us": st.p99_us, "maxUs": st.max_us}
+            for st in obs.RECORDER.snapshot().nonzero()
+        })
+        rec.add_source("slowRing", obs.RECORDER.slow_events)
+        if hasattr(core, "ingest_counters"):
+            rec.add_source("counters", core.ingest_counters)
+        if self._querytrace is not None:
+            rec.add_source("queries", self._querytrace.waterfall)
+        ing = self._mp_ingester
+        cp = getattr(ing, "critpath", None) if ing is not None else None
+        if cp is not None:
+            rec.add_source("critpath", cp.waterfall)
+
     async def get_metrics(self, request: web.Request) -> web.Response:
         """Actuator-style counters, reference taxonomy kept verbatim:
         ``counter.zipkin_collector.spans.http`` etc."""
@@ -851,6 +907,22 @@ class ZipkinServer:
                 "critpathLambdaCps", "critpathLittleL",
                 "critpathWorkerOccupancy", "critpathQueueSaturation",
                 "critpathConservationP50Milli",
+            ):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
+            # query-plane observatory (ISSUE 12): stitched query walls,
+            # the aggregator-lock contention ledger, and cached-read
+            # staleness (age-at-serve)
+            for name in (
+                "queryTraces", "queryWallP50Us", "queryWallP99Us",
+                "queryWallMaxUs", "queryConservationP50Milli",
+                "queryLockAcquisitions", "queryLockContended",
+                "queryLockReentries", "queryLockWaiters",
+                "queryLockWaitersHighWater", "queryLockWaitP50Us",
+                "queryLockWaitP99Us", "queryLockWaitMaxUs",
+                "queryLockHoldP50Us", "queryLockHoldP99Us",
+                "queryLockHoldMaxUs", "readCacheServeAgeMs",
+                "readCacheServeAgeMaxMs", "readCacheEntries",
             ):
                 if name in counters:
                     out[f"gauge.zipkin_tpu.{name}"] = counters[name]
@@ -935,6 +1007,13 @@ class ZipkinServer:
                 lines.append(f"{fam} {value}")
             lines.extend(_prom_mp_workers(counters.get("mpWorkerTable")))
             lines.extend(_prom_critpath(counters.get("critpathSegments")))
+            # the flat queryLock*/queryWall* gauges rode the loop above;
+            # this renders the labelled families (wait/hold histograms,
+            # per-label holder attribution, per-segment aggregates)
+            lines.extend(_prom_query_lock(counters.get("queryLock")))
+            lines.extend(
+                _prom_query_segments(counters.get("querySegments"))
+            )
         if getattr(self.storage, "sampler", None) is not None:
             # live per-service keep probability (1.0 = keep everything)
             rates = await asyncio.to_thread(self.storage.sampler_rates)
@@ -1046,6 +1125,15 @@ class ZipkinServer:
             cp = getattr(ing, "critpath", None)
             if cp is not None:
                 body["critpath"] = await asyncio.to_thread(cp.waterfall)
+        # query-plane observatory (ISSUE 12): stitched per-query
+        # waterfall (segment decomposition, conservation, the slowest
+        # query) + the aggregator-lock contention ledger
+        if self._querytrace is not None:
+            body["queries"] = await asyncio.to_thread(
+                self._querytrace.waterfall
+            )
+        if self._obs_incidents is not None:
+            body["incidents"] = self._obs_incidents.counters()
         return web.json_response(body)
 
     def _durability_status(self) -> Optional[dict]:
@@ -1268,6 +1356,88 @@ def _prom_critpath(segments) -> List[str]:
     for field, help_text, typ, suffix in fields:
         fam = _prom_name(f"zipkin_tpu_critpath_segment_{_snake(field)}{suffix}")
         lines.append(f"# HELP {fam} Critical-path segment {help_text}.")
+        lines.append(f"# TYPE {fam} {typ}")
+        for seg, row in sorted(segments.items()):
+            lines.append(
+                f'{fam}{{segment="{_prom_label(seg)}",'
+                f'kind="{_prom_label(row["kind"])}"}} {row[field]}'
+            )
+    return lines
+
+
+def _prom_query_lock(table) -> List[str]:
+    """Aggregator-lock contention ledger (ISSUE 12): native wait/hold
+    histogram families plus per-label holder attribution. The scalar
+    ``zipkin_tpu_query_lock_*`` gauges (acquisitions, waiters,
+    high-water, p50/p99) ride the flat render; the histograms and the
+    holder table need their own families."""
+    if not table:
+        return []
+    lines: List[str] = []
+    hists = (
+        ("wait", table.get("waitHist"), table.get("waitSumUs", 0),
+         "time a thread waited to acquire the aggregator lock"),
+        ("hold", table.get("holdHist"), table.get("holdSumUs", 0),
+         "time an outermost acquire held the aggregator lock"),
+    )
+    for which, hist, sum_us, help_text in hists:
+        if not hist or not sum(hist):
+            continue
+        fam = f"zipkin_tpu_query_lock_{which}_seconds"
+        lines.append(f"# HELP {fam} Lock ledger: {help_text}.")
+        lines.append(f"# TYPE {fam} histogram")
+        total = sum(hist)
+        cum = 0
+        for b, count in enumerate(hist[:-1]):
+            if not count:
+                continue
+            cum += count
+            le = obs.bucket_le_us(b) / 1e6
+            lines.append(f'{fam}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{fam}_bucket{{le="+Inf"}} {total}')
+        lines.append(f'{fam}_sum {sum_us / 1e6}')
+        lines.append(f'{fam}_count {total}')
+    holders = table.get("holders") or {}
+    if holders:
+        count_fam = "zipkin_tpu_query_lock_holds_total"
+        sum_fam = "zipkin_tpu_query_lock_hold_sum_us_total"
+        lines.append(
+            f"# HELP {count_fam} Outermost lock holds by holder label."
+        )
+        lines.append(f"# TYPE {count_fam} counter")
+        for label, row in sorted(holders.items()):
+            lines.append(
+                f'{count_fam}{{holder="{_prom_label(label)}"}} '
+                f'{row["count"]}'
+            )
+        lines.append(
+            f"# HELP {sum_fam} Cumulative hold microseconds by holder "
+            "label."
+        )
+        lines.append(f"# TYPE {sum_fam} counter")
+        for label, row in sorted(holders.items()):
+            lines.append(
+                f'{sum_fam}{{holder="{_prom_label(label)}"}} '
+                f'{row["holdSumUs"]}'
+            )
+    return lines
+
+
+def _prom_query_segments(segments) -> List[str]:
+    """Per-segment query critical-path aggregates, mirroring the
+    critpath segment families with segment+kind labels."""
+    if not segments:
+        return []
+    lines: List[str] = []
+    fields = (
+        ("count", "folded occurrences", "counter", "_total"),
+        ("sumUs", "cumulative wall microseconds", "counter", "_total"),
+        ("maxUs", "worst single occurrence microseconds", "gauge", ""),
+    )
+    for field, help_text, typ, suffix in fields:
+        fam = _prom_name(f"zipkin_tpu_query_segment_{_snake(field)}{suffix}")
+        lines.append(f"# HELP {fam} Query critical-path segment "
+                     f"{help_text}.")
         lines.append(f"# TYPE {fam} {typ}")
         for seg, row in sorted(segments.items()):
             lines.append(

@@ -119,6 +119,16 @@ class ServerConfig:
     obs_shadow_link_rate: float = 0.125
     obs_shadow_rollup_s: float = 5.0
     obs_shadow_pending_max: int = 512
+    # query-plane observatory (zipkin_tpu.obs.querytrace): per-query
+    # critical-path traces + the aggregator-lock contention ledger.
+    # TPU_OBS_QUERY gates both. Incident capture (zipkin_tpu.obs.
+    # incidents): when TPU_OBS_INCIDENT_DIR names a directory, every SLO
+    # trip snapshots the volatile observability planes into a bounded-
+    # retention JSON bundle there (TPU_OBS_INCIDENT_RETENTION newest
+    # kept; a flapping SLO cannot fill the disk).
+    obs_query_enabled: bool = True
+    obs_incident_dir: str = ""
+    obs_incident_retention: int = 16
     # TPU aggregation tier
     tpu_devices: Optional[int] = None  # None = all visible
     tpu_batch_size: int = 8192
@@ -247,6 +257,11 @@ class ServerConfig:
             obs_shadow_link_rate=_env_float("TPU_OBS_SHADOW_LINK_RATE", 0.125),
             obs_shadow_rollup_s=_env_float("TPU_OBS_SHADOW_ROLLUP_S", 5.0),
             obs_shadow_pending_max=_env_int("TPU_OBS_SHADOW_PENDING", 512),
+            obs_query_enabled=_env_bool("TPU_OBS_QUERY", True),
+            obs_incident_dir=os.environ.get("TPU_OBS_INCIDENT_DIR", ""),
+            obs_incident_retention=_env_int(
+                "TPU_OBS_INCIDENT_RETENTION", 16
+            ),
             tpu_devices=_env_int("TPU_DEVICES", 0) or None,
             tpu_batch_size=_env_int("TPU_BATCH_SIZE", 8192),
             tpu_fast_ingest=fast_ingest,

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 from typing import Optional, Sequence
 
+from zipkin_tpu.obs import querytrace
 from zipkin_tpu.tpu.state import AggConfig
 from zipkin_tpu.tpu.store import TpuStorage as _CoreTpuStorage
 
@@ -110,7 +111,13 @@ class TpuStorage(_CoreTpuStorage):
             # plane" for the boundary statement
             wal = wal_mod.WriteAheadLog(wal_dir, fsync=wal_fsync)
             t0 = time.perf_counter()
-            applied = wal_mod.replay(self, wal, from_seq=self.agg.wal_seq)
+            # contention-ledger attribution: boot replay holds the
+            # aggregator lock for whole batches; name it so a post-boot
+            # ledger read doesn't show a giant "unattributed" hold
+            with querytrace.lock_label("wal_replay"):
+                applied = wal_mod.replay(
+                    self, wal, from_seq=self.agg.wal_seq
+                )
             self.restore_stats["walReplayBatches"] = applied
             self.restore_stats["walReplayMs"] = round(
                 (time.perf_counter() - t0) * 1000.0, 3
@@ -185,7 +192,12 @@ class TpuStorage(_CoreTpuStorage):
                 # so the flag check is race-free
                 return None
             t0 = time.perf_counter()
-            path = save(self, self.checkpoint_dir, keep=self.snapshot_keep)
+            # ledger attribution: the save holds the aggregator lock
+            # while it reads device state out for persistence
+            with querytrace.lock_label("snapshot"):
+                path = save(
+                    self, self.checkpoint_dir, keep=self.snapshot_keep
+                )
             wal = getattr(self, "wal", None)
             if wal is not None:
                 covered = retained_coverage(self.checkpoint_dir)

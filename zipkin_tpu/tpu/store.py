@@ -32,6 +32,7 @@ import numpy as np
 
 from zipkin_tpu import obs, readpack
 from zipkin_tpu.internal.hex import epoch_minutes
+from zipkin_tpu.obs import querytrace
 from zipkin_tpu.ops import hll
 from zipkin_tpu.model.span import DependencyLink, Span
 from zipkin_tpu.storage.memory import InMemoryStorage
@@ -250,9 +251,14 @@ class TpuStorage(
         self._hll_beyond_envelope_rows = 0   # rows beyond, at last read
         # read cache: device pulls (merged digest/sketches) keyed by the
         # write version, so repeated queries between writes cost nothing
-        self._read_cache: dict = {}
+        self._read_cache: dict = {}   # key -> (value, born_monotonic)
         self._read_cache_version = -1
         self._read_cache_lock = threading.Lock()
+        # cached-read staleness: age-at-serve of the last hit and its
+        # high-water — "query_cached is fast" is only good news if the
+        # answers are also young; these gauges put a number on it
+        self._read_cache_age_ms = 0.0
+        self._read_cache_age_max_ms = 0.0
         # dependency answers additionally tolerate BOUNDED STALENESS
         # under sustained ingest (env TPU_DEPS_MAX_STALE_MS, default 5s;
         # 0 = always fresh): the reference's dependency table is written
@@ -266,6 +272,16 @@ class TpuStorage(
             _os.environ.get("TPU_DEPS_MAX_STALE_MS", 5000.0)
         )
         self._deps_cache: dict = {}
+        # query-plane observatory (obs/querytrace.py): per-query
+        # critical-path traces folded at tick cadence, plus the
+        # aggregator-lock contention ledger. lock_provider resolves
+        # self.agg lazily so clear()'s wholesale aggregator swap keeps
+        # the ledger pointed at the live instrumented lock.
+        self.querytrace = querytrace.QueryObservatory()
+        self.querytrace.lock_provider = (
+            lambda: getattr(self.agg, "lock", None)
+        )
+        self._query_obs_enabled: Optional[bool] = None
         # archive-only restart: segment columns store vocab IDS, so the
         # ids must survive the process or every recovered segment becomes
         # unsearchable. A snapshot restore (storage/tpu.py) replaces the
@@ -1003,6 +1019,7 @@ class TpuStorage(
         minutes and quantile lists, so per-key staleness checks alone
         would let dead entries accumulate forever under a polling UI."""
         t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
         version = self.agg.write_version
         with self._read_cache_lock:
             if self._read_cache_version != version:
@@ -1010,13 +1027,27 @@ class TpuStorage(
                 self._read_cache_version = version
             hit = self._read_cache.get(key)
             if hit is not None:
+                value, born = hit
+                age_ms = (time.monotonic() - born) * 1000.0
+                self._read_cache_age_ms = age_ms
+                if age_ms > self._read_cache_age_max_ms:
+                    self._read_cache_age_max_ms = age_ms
                 obs.record("query_cached", time.perf_counter() - t0)
-                return hit
+                querytrace.stamp_active(
+                    querytrace.QSEG_CACHE_PROBE, t0_ns,
+                    time.perf_counter_ns(),
+                )
+                return value
+        # the probe segment ends where compute() begins — on a miss the
+        # rest of the wall belongs to dispatch/transfer/unpack stamps
+        querytrace.stamp_active(
+            querytrace.QSEG_CACHE_PROBE, t0_ns, time.perf_counter_ns()
+        )
         value = compute()
         obs.record("query_fresh", time.perf_counter() - t0)
         with self._read_cache_lock:
             if self._read_cache_version == version:
-                self._read_cache[key] = value
+                self._read_cache[key] = (value, time.monotonic())
         return value
 
     def invalidate_read_cache(self) -> None:
@@ -1029,11 +1060,23 @@ class TpuStorage(
 
     def get_dependencies(self, end_ts: int, lookback: int) -> Call[List[DependencyLink]]:
         def run() -> List[DependencyLink]:
+            qt = self.querytrace.begin("dependencies")
+            try:
+                return self._get_dependencies(end_ts, lookback)
+            finally:
+                self.querytrace.finish(qt)
+
+        return Call.of(run)
+
+    def _get_dependencies(
+        self, end_ts: int, lookback: int
+    ) -> List[DependencyLink]:
             lo_min = epoch_minutes(end_ts - lookback)
             hi_min = epoch_minutes(end_ts)
             fresh = self.agg.write_version
             now = time.monotonic()
             t0 = time.perf_counter()
+            t0_ns = time.perf_counter_ns()
             with self._read_cache_lock:
                 hit = self._deps_cache.get((lo_min, hi_min))
                 if hit is not None:
@@ -1041,8 +1084,19 @@ class TpuStorage(
                     if version == fresh or (
                         (now - t) * 1000.0 < self._deps_max_stale_ms
                     ):
+                        age_ms = (now - t) * 1000.0
+                        self._read_cache_age_ms = age_ms
+                        if age_ms > self._read_cache_age_max_ms:
+                            self._read_cache_age_max_ms = age_ms
                         obs.record("query_cached", time.perf_counter() - t0)
+                        querytrace.stamp_active(
+                            querytrace.QSEG_CACHE_PROBE, t0_ns,
+                            time.perf_counter_ns(),
+                        )
                         return value
+            querytrace.stamp_active(
+                querytrace.QSEG_CACHE_PROBE, t0_ns, time.perf_counter_ns()
+            )
             value = self._compute_dependencies(lo_min, hi_min)
             with self._read_cache_lock:
                 self._deps_cache[(lo_min, hi_min)] = (value, fresh, now)
@@ -1055,8 +1109,6 @@ class TpuStorage(
                     if k != (lo_min, hi_min):
                         del self._deps_cache[k]
             return value
-
-        return Call.of(run)
 
     def _compute_dependencies(
         self, lo_min: int, hi_min: int
@@ -1089,6 +1141,7 @@ class TpuStorage(
                     flat_idx, dense_c[p_idx, c_idx], dense_e[p_idx, c_idx]
                 )
                 live = calls > 0
+            t0_ns = time.perf_counter_ns()
             out: List[DependencyLink] = []
             for flat, n_calls, n_errs in zip(idx[live], calls[live], errors[live]):
                 parent = self.vocab.services.lookup(int(flat) // s)
@@ -1103,6 +1156,9 @@ class TpuStorage(
                         error_count=int(n_errs),
                     )
                 )
+            querytrace.stamp_active(
+                querytrace.QSEG_LINK_RESOLVE, t0_ns, time.perf_counter_ns()
+            )
             return out
 
     def latency_quantiles(
@@ -1123,27 +1179,36 @@ class TpuStorage(
         windows return no rows; the all-time path has no window).
         Returns dicts: {service, spanName, count, quantiles: {q: µs}}.
         """
-        if end_ts is None and lookback is not None:
-            # Zipkin query convention: endTs defaults to "now" when only
-            # lookback is given (QueryRequest semantics, SURVEY.md §2.3)
-            end_ts = int(time.time() * 1000)
-        qkey = ",".join(f"{q:.6g}" for q in qs)
-        if end_ts is not None:
-            lb = lookback if lookback is not None else end_ts
-            lo_min = epoch_minutes(end_ts - lb)
-            hi_min = epoch_minutes(end_ts)
-            source_q, counts = self._cached_read(
-                f"quant:w:{lo_min}:{hi_min}:{qkey}",
-                lambda: self.agg.quantiles(qs, ts_lo_min=lo_min, ts_hi_min=hi_min),
-            )
-        else:
-            src = "digest" if use_digest else "hist"
-            source_q, counts = self._cached_read(
-                f"quant:{src}:{qkey}",
-                lambda: self.agg.quantiles(qs, source=src),
-            )
+        qt = self.querytrace.begin("quantiles")
+        try:
+            if end_ts is None and lookback is not None:
+                # Zipkin query convention: endTs defaults to "now" when
+                # only lookback is given (QueryRequest semantics,
+                # SURVEY.md §2.3)
+                end_ts = int(time.time() * 1000)
+            qkey = ",".join(f"{q:.6g}" for q in qs)
+            if end_ts is not None:
+                lb = lookback if lookback is not None else end_ts
+                lo_min = epoch_minutes(end_ts - lb)
+                hi_min = epoch_minutes(end_ts)
+                source_q, counts = self._cached_read(
+                    f"quant:w:{lo_min}:{hi_min}:{qkey}",
+                    lambda: self.agg.quantiles(
+                        qs, ts_lo_min=lo_min, ts_hi_min=hi_min
+                    ),
+                )
+            else:
+                src = "digest" if use_digest else "hist"
+                source_q, counts = self._cached_read(
+                    f"quant:{src}:{qkey}",
+                    lambda: self.agg.quantiles(qs, source=src),
+                )
 
-        return self._quantile_rows(qs, source_q, counts, service_name, span_name)
+            return self._quantile_rows(
+                qs, source_q, counts, service_name, span_name
+            )
+        finally:
+            self.querytrace.finish(qt)
 
     def _quantile_rows(
         self,
@@ -1155,6 +1220,24 @@ class TpuStorage(
     ) -> List[dict]:
         """Shape pulled ([K, Q], [K]) quantile arrays into API rows —
         shared by latency_quantiles and the coalesced sketch_overview."""
+        t0_ns = time.perf_counter_ns()
+        try:
+            return self._quantile_rows_inner(
+                qs, source_q, counts, service_name, span_name
+            )
+        finally:
+            querytrace.stamp_active(
+                querytrace.QSEG_SERIALIZE, t0_ns, time.perf_counter_ns()
+            )
+
+    def _quantile_rows_inner(
+        self,
+        qs: Sequence[float],
+        source_q: np.ndarray,
+        counts: np.ndarray,
+        service_name: Optional[str],
+        span_name: Optional[str],
+    ) -> List[dict]:
         want_svc = (
             self.vocab.services.get(service_name.lower()) if service_name else None
         )
@@ -1213,8 +1296,12 @@ class TpuStorage(
 
     def trace_cardinalities(self) -> dict:
         """Estimated distinct trace counts: {"_global": n, service: n, ...}."""
-        est = self._cached_read("card", self.agg.cardinalities)
-        return self._cardinality_rows(est)
+        qt = self.querytrace.begin("cardinalities")
+        try:
+            est = self._cached_read("card", self.agg.cardinalities)
+            return self._cardinality_rows(est)
+        finally:
+            self.querytrace.finish(qt)
 
     def sketch_overview(
         self,
@@ -1227,18 +1314,22 @@ class TpuStorage(
         rows, "cardinalities": trace_cardinalities dict, "counters":
         ingest_counters dict}. Replaces three aggregator reads (and three
         HTTP round trips) per page refresh."""
-        qkey = ",".join(f"{q:.6g}" for q in qs)
-        source_q, counts, est = self._cached_read(
-            f"overview:{qkey}",
-            lambda: self.agg.sketch_overview(qs),
-        )
-        return {
-            "percentiles": self._quantile_rows(
-                qs, source_q, counts, service_name, span_name
-            ),
-            "cardinalities": self._cardinality_rows(est),
-            "counters": self.ingest_counters(),
-        }
+        qt = self.querytrace.begin("overview")
+        try:
+            qkey = ",".join(f"{q:.6g}" for q in qs)
+            source_q, counts, est = self._cached_read(
+                f"overview:{qkey}",
+                lambda: self.agg.sketch_overview(qs),
+            )
+            return {
+                "percentiles": self._quantile_rows(
+                    qs, source_q, counts, service_name, span_name
+                ),
+                "cardinalities": self._cardinality_rows(est),
+                "counters": self.ingest_counters(),
+            }
+        finally:
+            self.querytrace.finish(qt)
 
     def ingest_counters(self) -> dict:
         from zipkin_tpu.obs.device import OBSERVATORY
@@ -1307,7 +1398,29 @@ class TpuStorage(
                 if self.accuracy is not None
                 else {}
             ),
+            # query-plane observatory (obs/querytrace.py): stitched
+            # per-query aggregates + the aggregator-lock contention
+            # ledger (queryLock* gauges; the nested queryLock table is
+            # skipped by flat consumers, rendered by /prometheus)
+            **self.querytrace.counters(),
+            # cached-read staleness: age-at-serve of the last cache hit
+            # (read cache or bounded-stale deps cache), its high-water,
+            # and the live read-cache entry count
+            "readCacheServeAgeMs": round(self._read_cache_age_ms, 3),
+            "readCacheServeAgeMaxMs": round(self._read_cache_age_max_ms, 3),
+            "readCacheEntries": len(self._read_cache),
         }
+
+    def set_query_observatory(self, on: bool) -> None:
+        """Enable/disable per-query tracing and the lock ledger together
+        (server config plumb-through). Remembered so :meth:`clear`'s
+        aggregator swap — which builds a fresh instrumented lock with
+        the env default — reapplies the configured state."""
+        self._query_obs_enabled = bool(on)
+        self.querytrace.enabled = bool(on)
+        lk = getattr(self.agg, "lock", None)
+        if lk is not None and hasattr(lk, "set_enabled"):
+            lk.set_enabled(on)
 
     def sampler_rates(self) -> dict:
         """{service: keep fraction} from the published rate table — the
@@ -1352,3 +1465,8 @@ class TpuStorage(
 
         self._archive.clear()
         self.agg = ShardedAggregator(self.config, mesh=self.agg.mesh)
+        # the swap replaced the instrumented lock; drop stitched state
+        # from the old aggregator and reapply configured enablement
+        self.querytrace.reset()
+        if self._query_obs_enabled is not None:
+            self.set_query_observatory(self._query_obs_enabled)
