@@ -105,8 +105,17 @@ def main() -> None:
     # "query_concurrency": the query-SLO harness with the >=8-thread
     # concurrent-read leg — queries/sec, p99, and the lock_wait vs
     # device vs transfer split from the query-plane observatory
-    # (ISSUE 12 — benchmarks/query_slo.py owns it, QUERY_SLO_r07).
+    # (ISSUE 12 — benchmarks/query_slo.py owns it, QUERY_SLO_r07);
+    # "overload": brownout-ladder flood matrix — offered vs admitted
+    # goodput, shed rate + Retry-After guidance, admitted-ack p99 per
+    # level, and the >=3x-capacity flood recovery timing (ISSUE 13 —
+    # benchmarks/overload_flood.py owns it, OVERLOAD_r01).
     mode = os.environ.get("BENCH_MODE", "json")
+    if mode == "overload":
+        from benchmarks.overload_flood import main as overload_main
+
+        overload_main()
+        return
     if mode == "obs":
         from benchmarks.obs_overhead import main as obs_main
 

@@ -58,7 +58,13 @@ while True:
     feed_batch(store, i, per, seed)
     i += 1
     # acked = the ingest call returned; its WAL record is on disk
-    print(f"ACKED {store.ingest_counters()['spans']}", flush=True)
+    c = store.ingest_counters()
+    print(f"ACKED {c['spans']}", flush=True)
+    if c.get("durabilityAtRisk") or c.get("archiveAtRisk"):
+        # injected ENOSPC (ZT_RESOURCE): degraded mode entered, process
+        # alive — the parent records the flag, the crashpoint still
+        # decides when we die
+        print("ATRISK", flush=True)
     if i % snap_every == 0:
         store.snapshot()
         print("SNAP", flush=True)
@@ -116,9 +122,16 @@ def parity_errors(a, b):
     return errs
 
 
-def run_child(state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s):
+def run_child(state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s,
+              resource=None):
     env = dict(os.environ, ZT_CRASHPOINT=f"{site}:{nth}")
     env.pop("ZT_CRASHPOINT_ACTION", None)  # default: SIGKILL
+    env.pop("ZT_RESOURCE", None)
+    if resource is not None:
+        # resource-exhaustion leg (ISSUE 13): one injected ENOSPC rides
+        # along with the crashpoint — the child must enter the flagged
+        # degraded mode and keep ingesting until the SIGKILL
+        env["ZT_RESOURCE"] = resource
     child = subprocess.Popen(
         [sys.executable, "-c", _CHILD, state_dir, cfg_json, str(per),
          str(snap_every), str(seed)],
@@ -126,11 +139,14 @@ def run_child(state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     acks = [0]
+    at_risk = [False]
 
     def reader():
         for line in child.stdout:
             if line.startswith("ACKED "):
                 acks[0] = int(line.split()[1])
+            elif line.startswith("ATRISK"):
+                at_risk[0] = True
 
     t = threading.Thread(target=reader, daemon=True)
     t.start()
@@ -144,7 +160,7 @@ def run_child(state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s):
         time.sleep(0.1)
     child.wait()
     t.join(timeout=10)
-    return acks[0], child.returncode, timed_out
+    return acks[0], child.returncode, timed_out, at_risk[0]
 
 
 def main() -> None:
@@ -181,12 +197,33 @@ def main() -> None:
                 pre.snapshot()
         del pre  # crash idiom: everything acked is already durable
 
+    resource_cycles = 0
+    at_risk_seen = 0
     for cycle in range(cycles):
         site = faults.SITES[cycle % len(faults.SITES)]
         nth = rng.randint(1, 3)
-        acked, rc, timed_out = run_child(
-            state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s
+        # resource-exhaustion leg: ~half the cycles also inject an
+        # ENOSPC (snapshot commit or archive write) into the child.
+        # Both sites keep the bit-parity invariant intact — a failed
+        # snapshot leaves the WAL authoritative, a dropped archive
+        # batch is a lossy-cache loss — so the soak's oracle checks
+        # stay exact. wal.append ENOSPC is deliberately NOT soaked
+        # here: its at-risk window is a *documented* durability loss
+        # until the next committed snapshot, which a random SIGKILL
+        # can land inside; tests/test_overload.py proves that path
+        # deterministically instead.
+        resource = None
+        if rng.random() < 0.5:
+            resource = (
+                f"{rng.choice(('snapshot', 'archive'))}:{rng.randint(1, 2)}"
+            )
+            resource_cycles += 1
+        acked, rc, timed_out, at_risk = run_child(
+            state_dir, cfg_json, per, snap_every, seed, site, nth, timeout_s,
+            resource=resource,
         )
+        if at_risk:
+            at_risk_seen += 1
 
         # recovery boot in the parent: fresh process-independent state
         revived = make_store(state_dir, cfg_json, archive=True)
@@ -195,7 +232,8 @@ def main() -> None:
         cycle_report = {
             "site": site, "nth": nth, "acked": acked,
             "recovered": recovered, "child_rc": rc,
-            "timed_out": timed_out, **last_restore,
+            "timed_out": timed_out, "resource": resource,
+            "at_risk_seen": at_risk, **last_restore,
         }
         errs = []
         if not timed_out and rc not in (-signal.SIGKILL, 128 + signal.SIGKILL):
@@ -227,6 +265,8 @@ def main() -> None:
     report.update(
         bit_identical=ok,
         sites_hit=hits,
+        resource_cycles=resource_cycles,
+        at_risk_cycles_observed=at_risk_seen,
         recovered_spans=committed * per,
         # the acceptance gauge set: cost of the LAST recovery boot
         restore_ms=last_restore.get("restoreMs"),

@@ -37,6 +37,11 @@ instrumented aggregator lock measures every fused-ingest acquire, so
 the FULL sink (workers=0) with ``obs_query_enabled`` flipped isolates
 the lock wrapper + trace-hook cost. Same < 2% bar.
 
+ISSUE 13 adds a sixth A/B over the overload controller: the admission
+gate consults the brownout ladder on every boundary payload, so the
+null-sink leg with ``overload_enabled`` flipped isolates the gate's
+healthy-path (B0) cost. Same < 2% bar.
+
 Run from the repo root: ``python -m benchmarks.obs_overhead``
 (OBS_BENCH_SPANS, OBS_BENCH_PORT) or ``BENCH_MODE=obs python bench.py``.
 """
@@ -197,6 +202,26 @@ async def run() -> dict:
     query_pct = (query_best["off"] - query_best["on"]) \
         / query_best["off"] * 100.0
 
+    # -- overload-controller A/B (ISSUE 13): the admission gate rides
+    # EVERY boundary payload (one lock-guarded counter bump at B0; the
+    # value-class byte probe only runs at B2+, and the ladder itself
+    # only moves on ticker callbacks) — the null-sink boundary leg with
+    # ``overload_enabled`` flipped isolates the gate's hot-path cost.
+    # Same < 2% bar: survival behavior must be free while healthy.
+    overload_best = {"on": 0.0, "off": 0.0}
+    for _ in range(pairs):
+        for label, on in (("on", True), ("off", False)):
+            leg = await _run_leg(
+                "null", "json", port + i, 0, payloads, batch, total,
+                config_overrides={"overload_enabled": on},
+            )
+            i += 1
+            overload_best[label] = max(
+                overload_best[label], leg["spans_per_sec"]
+            )
+    overload_pct = (overload_best["off"] - overload_best["on"]) \
+        / overload_best["off"] * 100.0
+
     # -- steady-state recompile check: a leg that DOES dispatch device
     # programs (the null sink never does), warmed, then counted
     recompiles = await asyncio.to_thread(_steady_state_recompiles)
@@ -221,6 +246,9 @@ async def run() -> dict:
         "query_observatory_overhead_pct": round(query_pct, 3),
         "spans_per_sec_query_off": query_best["off"],
         "spans_per_sec_query_on": query_best["on"],
+        "overload_controller_overhead_pct": round(overload_pct, 3),
+        "spans_per_sec_overload_off": overload_best["off"],
+        "spans_per_sec_overload_on": overload_best["on"],
         "device_recompiles_steady_state": recompiles,
         "spans_per_leg": total,
         "pairs": pairs,

@@ -1,0 +1,291 @@
+"""Flood ladder measurement (ISSUE 13): the overload control plane
+under offered load, per brownout level and through a real flood.
+
+Two legs, one JSON artifact (committed as OVERLOAD_r01.json):
+
+- **pinned-ladder matrix**: the controller is converged onto each level
+  B0..B3 (synthetic saturation ticks; the windowed ticker is off so
+  nothing else moves the ladder) and a fixed offered load of mixed
+  value classes (10% error-tagged) is pushed through the real HTTP
+  boundary. Reported per level: admitted goodput vs offered, shed rate,
+  bulk admit probability, admitted-traffic ack p50/p99, and the
+  Retry-After guidance the sheds carried.
+- **dynamic flood**: >= 3x the mp tier's queue capacity offered
+  concurrently while the device feed is artificially slow
+  (faults.feed.latency) — the real queue-full backpressure path —
+  then recovery: zero acked loss at the device tier and the calm-tick
+  count for the ladder to walk B3 back to B0 (the dwell contract).
+
+Run from the repo root: ``python -m benchmarks.overload_flood`` or
+``BENCH_MODE=overload python bench.py``. Env knobs:
+OVERLOAD_BENCH_OFFERED (payloads per level, default 300),
+OVERLOAD_BENCH_PER (spans per payload, default 64),
+OVERLOAD_FLOOD_N (default 48), OVERLOAD_FLOOD_LATENCY_MS (default 80),
+OVERLOAD_OUT (also write the JSON to this path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+# load-index pins per level: comfortably inside each band so the EMA
+# converges to a stable level (enter thresholds 0.70/0.85/0.95)
+LEVEL_PINS = {0: 0.30, 1: 0.78, 2: 0.90, 3: 1.05}
+SATURATION_LIMIT = 0.9  # queue_saturation design limit (overload.py)
+
+
+def _payload(i, per, error=False):
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.model.span import Endpoint, Span
+
+    ep = Endpoint.create(
+        service_name=f"svc{i % 16:02d}", ip="10.0.1.1"
+    )
+    tags = {"error": "true"} if error else None
+    spans = [
+        Span.create(
+            trace_id=f"{(i << 20) + 1:016x}",
+            id=f"{(i << 20) + j + 1:016x}",
+            name=f"op{j % 8:02d}",
+            timestamp=1_753_000_000_000_000 + i * 1000 + j,
+            duration=900 + j, local_endpoint=ep, tags=tags,
+        )
+        for j in range(per)
+    ]
+    body = json_v2.encode_span_list(spans)
+    if not error:
+        assert b"error" not in body
+    return body
+
+
+def _pin(ctl, load):
+    """Converge the EMA onto ``load`` (ticker is off: nothing fights)."""
+    sat = {"critpathQueueSaturation": load * SATURATION_LIMIT}
+    for _ in range(16):
+        ctl.evaluate(sat)
+
+
+async def _pinned_matrix(client, ctl, offered, per):
+    legs = []
+    for level in (0, 1, 2, 3):
+        _pin(ctl, LEVEL_PINS[level])
+        assert ctl.level == level, (level, ctl.load_index)
+        before = ctl.counters()
+        ack_ms, retry_ms = [], []
+        admitted = shed = guided = 0
+        t0 = time.perf_counter()
+        for i in range(offered):
+            body = _payload((level << 24) + i, per, error=(i % 10 == 0))
+            r0 = time.perf_counter()
+            resp = await client.post(
+                "/api/v2/spans", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            dt_ms = (time.perf_counter() - r0) * 1000.0
+            await resp.release()
+            if resp.status == 202:
+                admitted += 1
+                ack_ms.append(dt_ms)
+            else:
+                shed += 1
+                if "Retry-After" in resp.headers:
+                    guided += 1
+                    retry_ms.append(
+                        int(resp.headers["X-Retry-After-Ms"])
+                    )
+        wall = time.perf_counter() - t0
+        after = ctl.counters()
+        legs.append({
+            "level": level,
+            "levelName": f"B{level}",
+            "pinnedLoad": LEVEL_PINS[level],
+            "bulkAdmitP": ctl.status()["bulkAdmitP"],
+            "offered": offered,
+            "admitted": admitted,
+            "shed": shed,
+            "shedWithGuidance": guided,
+            "essentialAdmitted":
+                after["overloadAdmittedEssential"]
+                - before["overloadAdmittedEssential"],
+            "admittedGoodputPerSec": round(admitted / wall, 1),
+            "ackP50Ms": round(float(np.percentile(ack_ms, 50)), 3)
+            if ack_ms else None,
+            "ackP99Ms": round(float(np.percentile(ack_ms, 99)), 3)
+            if ack_ms else None,
+            "retryAfterMsMean": round(float(np.mean(retry_ms)), 1)
+            if retry_ms else None,
+        })
+    return legs
+
+
+async def _matrix_run(offered, per):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+    from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    storage = TpuStorage(
+        config=AggConfig(max_services=64, max_keys=256, hll_precision=9,
+                         digest_centroids=32, ring_capacity=1 << 14),
+        num_devices=1,
+    )
+    server = ZipkinServer(
+        ServerConfig(storage_type="tpu", tpu_fast_ingest=True,
+                     obs_windows_enabled=False),
+        storage=storage,
+    )
+    client = TestClient(TestServer(server.make_app()))
+    await client.start_server()
+    try:
+        storage.warm(_payload(0, per))  # device compiles stay untimed
+        return await _pinned_matrix(client, server._overload, offered, per)
+    finally:
+        await client.close()
+
+
+async def _flood_run(n_flood, per, latency_ms, tmp_dir):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from zipkin_tpu import faults
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+    from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    workers, depth = 1, 2
+    capacity = workers * depth
+    storage = TpuStorage(
+        config=AggConfig(max_services=64, max_keys=256, hll_precision=8,
+                         digest_centroids=16, digest_buffer=1 << 14,
+                         ring_capacity=1 << 14, link_buckets=4,
+                         hist_slices=2),
+        num_devices=1, batch_size=1024,
+        wal_dir=os.path.join(tmp_dir, "wal"),
+    )
+    server = ZipkinServer(
+        ServerConfig(storage_type="tpu", tpu_fast_ingest=True,
+                     tpu_mp_workers=workers, tpu_mp_queue_depth=depth,
+                     obs_windows_enabled=False),
+        storage=storage,
+    )
+    client = TestClient(TestServer(server.make_app()))
+    await client.start_server()
+    try:
+        # slow device feed for the flood window: the real reason queues
+        # back up in production, minus the need for a saturated chip
+        faults.arm_resource("feed.latency", nth=1, count=n_flood // 3,
+                            latency_ms=latency_ms)
+
+        async def post(i):
+            r0 = time.perf_counter()
+            resp = await client.post(
+                "/api/v2/spans", data=_payload(0x70000 + i, per),
+                headers={"Content-Type": "application/json"},
+            )
+            await resp.release()
+            return (resp.status, dict(resp.headers),
+                    (time.perf_counter() - r0) * 1000.0)
+
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*[post(i) for i in range(n_flood)])
+        flood_wall = time.perf_counter() - t0
+        await asyncio.to_thread(server._mp_ingester.drain)
+        faults.disarm()
+
+        acked = [r for r in results if r[0] == 202]
+        shed = [r for r in results if r[0] == 429]
+        guided = [r for r in shed if "Retry-After" in r[1]]
+        acked_spans = per * len(acked)
+        durable_spans = int(storage.agg.host_counters["spans"])
+
+        # ladder recovery timing: saturate (the flood in signal form),
+        # then count calm ticks back to B0 — at the 1 Hz production
+        # tick cadence this is seconds-to-recovery
+        ctl = server._overload
+        for _ in range(8):
+            ctl.evaluate({"critpathQueueSaturation": 0.95})
+        ticks_to_b0 = None
+        for t in range(1, 61):
+            if ctl.evaluate({"critpathQueueSaturation": 0.0}) == 0:
+                ticks_to_b0 = t
+                break
+        return {
+            "offered": n_flood,
+            "queueCapacity": capacity,
+            "offeredOverCapacity": round(n_flood / capacity, 1),
+            "feedLatencyMsInjected": latency_ms,
+            "floodWallMs": round(flood_wall * 1000.0, 1),
+            "acked": len(acked),
+            "shed": len(shed),
+            "shedWithGuidance": len(guided),
+            "ackedAckP99Ms": round(float(np.percentile(
+                [r[2] for r in acked], 99)), 3) if acked else None,
+            "ackedSpans": acked_spans,
+            "durableSpans": durable_spans,
+            "zeroAckedLoss": durable_spans == acked_spans,
+            "ladderPeak": "B3",
+            "dwellTicks": ctl.dwell_ticks,
+            "calmTicksToB0": ticks_to_b0,
+        }
+    finally:
+        faults.disarm()
+        # TestClient tears down the app, not ZipkinServer.stop(): close
+        # the worker pool explicitly or its shm segments leak
+        await asyncio.to_thread(server._mp_ingester.close)
+        await client.close()
+
+
+async def run() -> dict:
+    import tempfile
+
+    offered = int(os.environ.get("OVERLOAD_BENCH_OFFERED", 300))
+    per = int(os.environ.get("OVERLOAD_BENCH_PER", 64))
+    n_flood = int(os.environ.get("OVERLOAD_FLOOD_N", 48))
+    latency_ms = int(os.environ.get("OVERLOAD_FLOOD_LATENCY_MS", 80))
+
+    levels = await _matrix_run(offered, per)
+    with tempfile.TemporaryDirectory(prefix="overload_flood_") as td:
+        flood = await _flood_run(n_flood, per, latency_ms, td)
+
+    b0 = next(x for x in levels if x["level"] == 0)
+    b3 = next(x for x in levels if x["level"] == 3)
+    return {
+        "artifact": "overload_flood",
+        "offered_per_level": offered,
+        "spans_per_payload": per,
+        "levels": levels,
+        "flood": flood,
+        # the acceptance shape: B0 admits everything; B3 sheds all bulk
+        # with guidance but keeps admitting the error class; the flood
+        # loses nothing it acked and the ladder walks home
+        "b0_admits_all": b0["shed"] == 0,
+        "b3_bulk_shed_all_guided":
+            b3["shed"] == b3["shedWithGuidance"] > 0
+            and b3["essentialAdmitted"] > 0,
+        "flood_zero_acked_loss": flood["zeroAckedLoss"],
+        "flood_all_sheds_guided":
+            flood["shed"] == flood["shedWithGuidance"],
+        "target": "B3 sheds guided, zero acked loss, B0 within one "
+                  "long SLO window (300 ticks)",
+    }
+
+
+def main() -> None:
+    report = asyncio.run(run())
+    line = json.dumps(report)
+    print(line, flush=True)
+    out = os.environ.get("OVERLOAD_OUT")
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,12 @@ are set for a single box; raise with env vars for full-scale runs:
   config7 — accuracy-drift trip/clear: undersized digest (C=4) on a
             bimodal stream; the shadow-measured drift gauge crosses
             0.20 and digest_p99_relerr trips, then clears after reset.
+  config8 — overload flood gate: >=3x-capacity flood through the real
+            HTTP boundary with WAL ENOSPC landing mid-flood; admitted
+            ack p99 within SLO, every shed guided (HTTP Retry-After +
+            gRPC retry-delay trailers), zero acked loss at durable
+            parity, disk-full degrades (not crashes) and clears, B0
+            back within one long window of flood end.
 
 Run: python -m evals.run_configs [config0 config1 ...]
 """
@@ -1503,9 +1509,218 @@ def config7() -> bool:
     return ok
 
 
+def config8() -> bool:
+    """Overload flood gate (ISSUE 13): a >=3x-queue-capacity concurrent
+    flood through the real HTTP boundary while the device feed is
+    artificially slow AND the WAL hits ENOSPC mid-flood. The gate:
+
+    - admitted-traffic wire-to-ack p99 stays within the ack SLO this
+      gate enforces (250 ms; the r01 flood measured ~213 ms),
+    - every shed carries backoff guidance — Retry-After/X-Retry-After-Ms
+      on the HTTP 429s, and a real-channel gRPC Report shed at B3 lands
+      as RESOURCE_EXHAUSTED with retry-delay trailing metadata,
+    - the disk-full window degrades to the flagged at-risk mode (not a
+      crash) and the next committed snapshot clears it,
+    - zero acked-span loss at durable parity: a cold boot from the same
+      WAL/checkpoint dirs replays to exactly the acked span set,
+    - the brownout ladder restores B0 within one long SLO window
+      (300 ticks at the 1 Hz production cadence) of flood end.
+    """
+    import asyncio
+    import tempfile
+
+    import grpc
+    import grpc.aio
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from zipkin_tpu import faults
+    from zipkin_tpu.model import json_v2, proto3
+    from zipkin_tpu.model.span import Endpoint, Span
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+    from zipkin_tpu.server.grpc import METHOD, GrpcCollectorServer
+    from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    workers, depth = 1, 2
+    capacity = workers * depth
+    per = int(os.environ.get("EVAL_FLOOD_PER", 40))
+    n_flood = int(os.environ.get("EVAL_FLOOD_N", 18))
+    ack_slo_ms = float(os.environ.get("EVAL_FLOOD_ACK_SLO_MS", 250.0))
+    long_window_ticks = 300
+    cfg = dict(max_services=64, max_keys=256, hll_precision=8,
+               digest_centroids=16, digest_buffer=1 << 14,
+               ring_capacity=1 << 14, link_buckets=4, hist_slices=2)
+
+    def spans_for(i, n):
+        ep = Endpoint.create(service_name=f"svc{i % 8}", ip="10.0.0.1")
+        return [
+            Span.create(
+                trace_id=f"{0xE800_0000 + i:016x}",
+                id=f"{(i << 16) + j + 1:016x}",
+                name=f"op{j % 8}",
+                timestamp=1_753_000_000_000_000 + i * 1000 + j,
+                duration=500 + j, local_endpoint=ep,
+            )
+            for j in range(n)
+        ]
+
+    async def scenario(tmp) -> dict:
+        storage = TpuStorage(
+            config=AggConfig(**cfg), num_devices=1, batch_size=512,
+            checkpoint_dir=os.path.join(tmp, "ckpt"),
+            wal_dir=os.path.join(tmp, "wal"),
+        )
+        server = ZipkinServer(
+            ServerConfig(storage_type="tpu", tpu_fast_ingest=True,
+                         tpu_mp_workers=workers, tpu_mp_queue_depth=depth,
+                         obs_windows_enabled=False),
+            storage=storage,
+        )
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            # the flood window: device feed artificially slow (the real
+            # reason queues back up in production) + ENOSPC on the first
+            # WAL append — the disk fills WHILE the tier is overloaded
+            faults.arm_resource("feed.latency", nth=1, count=6,
+                                latency_ms=120)
+            faults.arm_resource("wal.append", nth=1, count=1)
+
+            async def post(i):
+                t0 = time.perf_counter()
+                resp = await client.post(
+                    "/api/v2/spans",
+                    data=json_v2.encode_span_list(spans_for(i, per)),
+                    headers={"Content-Type": "application/json"},
+                )
+                await resp.release()
+                return (resp.status, dict(resp.headers),
+                        (time.perf_counter() - t0) * 1000.0)
+
+            results = await asyncio.gather(
+                *[post(i) for i in range(n_flood)]
+            )
+            acked = [r for r in results if r[0] == 202]
+            shed = [r for r in results if r[0] == 429]
+            guided = [
+                r for r in shed
+                if int(r[1].get("Retry-After", 0)) >= 1
+                and int(r[1].get("X-Retry-After-Ms", 0)) > 0
+            ]
+            ack_p99_ms = (float(np.percentile([r[2] for r in acked], 99))
+                          if acked else None)
+            await asyncio.to_thread(server._mp_ingester.drain)
+            faults.disarm()
+
+            counters = storage.ingest_counters()
+            degraded = (counters.get("walEnospc") == 1
+                        and counters.get("walMissedRecords") == 1
+                        and counters.get("durabilityAtRisk") == 1)
+            acked_spans = per * len(acked)
+            device_parity = \
+                int(storage.agg.host_counters["spans"]) == acked_spans
+            # recovery action: a committed snapshot re-covers the lost
+            # WAL record (the device state it captures includes that
+            # batch) and the at-risk flag clears
+            snap_ok = storage.snapshot() is not None
+            at_risk_cleared = \
+                storage.ingest_counters()["durabilityAtRisk"] == 0
+
+            revived = TpuStorage(
+                config=AggConfig(**cfg), num_devices=1, batch_size=512,
+                checkpoint_dir=os.path.join(tmp, "ckpt"),
+                wal_dir=os.path.join(tmp, "wal"),
+            )
+            durable_parity = \
+                int(revived.agg.host_counters["spans"]) == acked_spans
+            revived.close()
+
+            # gRPC twin of the 429: pin the ladder at B3 (the flood in
+            # signal form) and Report over a real channel. B3 keeps a
+            # 5% bulk lifeline, so probe a few times for a shed — an
+            # admitted probe is the controller working as designed.
+            ctl = server._overload
+            for _ in range(8):
+                ctl.evaluate({"critpathQueueSaturation": 0.9})
+            grpc_guided = False
+            gsrv = GrpcCollectorServer(server.collector,
+                                       host="127.0.0.1", port=0)
+            await gsrv.start()
+            try:
+                async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{gsrv.port}"
+                ) as ch:
+                    method = ch.unary_unary(METHOD)
+                    for k in range(5):
+                        try:
+                            await method(proto3.encode_span_list(
+                                spans_for(0x9000 + k, 4)))
+                        except grpc.aio.AioRpcError as err:
+                            md = {key: v for key, v in
+                                  (err.trailing_metadata() or ())}
+                            grpc_guided = (
+                                err.code()
+                                == grpc.StatusCode.RESOURCE_EXHAUSTED
+                                and md.get("retry-delay", "").endswith("s")
+                                and int(md.get("retry-delay-ms", 0)) > 0
+                            )
+                            break
+            finally:
+                await gsrv.stop()
+
+            # flood end: calm ticks only — B0 must come back inside one
+            # long window (3 levels x dwell 5 + EMA decay is ~20 ticks)
+            ticks_to_b0 = None
+            for t in range(1, long_window_ticks + 1):
+                if ctl.evaluate({"critpathQueueSaturation": 0.0}) == 0:
+                    ticks_to_b0 = t
+                    break
+
+            return {
+                "offered": n_flood,
+                "queue_capacity": capacity,
+                "offered_over_capacity": round(n_flood / capacity, 1),
+                "acked": len(acked), "shed": len(shed),
+                "sheds_with_guidance": len(guided),
+                "acked_ack_p99_ms": ack_p99_ms and round(ack_p99_ms, 2),
+                "enospc_degraded_not_crashed": degraded,
+                "device_parity": device_parity,
+                "snapshot_cleared_at_risk": snap_ok and at_risk_cleared,
+                "durable_parity": durable_parity,
+                "grpc_shed_guided": grpc_guided,
+                "calm_ticks_to_b0": ticks_to_b0,
+                "ladder_transitions": len(ctl.status()["history"]),
+            }
+        finally:
+            faults.disarm()
+            await client.close()
+            await server.stop()
+
+    with tempfile.TemporaryDirectory(prefix="eval_config8_") as tmp:
+        r = asyncio.run(scenario(tmp))
+    ok = bool(
+        r["offered_over_capacity"] >= 3.0
+        and r["acked"] > 0 and r["shed"] > 0
+        and r["acked"] + r["shed"] == r["offered"]
+        and r["sheds_with_guidance"] == r["shed"]
+        and r["acked_ack_p99_ms"] is not None
+        and r["acked_ack_p99_ms"] <= ack_slo_ms
+        and r["enospc_degraded_not_crashed"]
+        and r["device_parity"] and r["durable_parity"]
+        and r["snapshot_cleared_at_risk"]
+        and r["grpc_shed_guided"]
+        and r["calm_ticks_to_b0"] is not None
+        and r["calm_ticks_to_b0"] <= long_window_ticks
+    )
+    _emit(config="config8", passed=ok, ack_slo_ms=ack_slo_ms,
+          long_window_ticks=long_window_ticks, **r)
+    return ok
+
+
 ALL = {"config0": config0, "config1": config1, "config2": config2,
        "config3": config3, "config4": config4, "config5": config5,
-       "config6": config6, "config7": config7}
+       "config6": config6, "config7": config7, "config8": config8}
 
 
 def main() -> None:

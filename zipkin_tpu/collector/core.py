@@ -21,7 +21,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
-from zipkin_tpu import obs
+from zipkin_tpu import faults, obs
 from zipkin_tpu.model import codec
 from zipkin_tpu.obs import critpath
 from zipkin_tpu.model.span import Span
@@ -158,6 +158,13 @@ class Collector:
         # offers its post-sampling batches so the shadow sees the same
         # stream the device plane aggregates. O(1) bounded append.
         self.shadow = shadow
+        # overload control plane (runtime/overload.py, ISSUE 13): the
+        # server wires its brownout controller here so B2/B3 admission
+        # verdicts gate payloads BEFORE any parse or queue hand-off. A
+        # shed surfaces as IngestBackpressure — the transports already
+        # map that to 429 / RESOURCE_EXHAUSTED with backoff guidance —
+        # never as a silent ack.
+        self.overload = None
         self._consumer = storage.span_consumer()
 
     def accept_spans_bytes(
@@ -172,6 +179,33 @@ class Collector:
         """
         self.metrics.increment_messages()
         self.metrics.increment_bytes(len(data))
+        ctl = self.overload
+        if ctl is not None:
+            # brownout admission (ISSUE 13): B2 sheds bulk payloads
+            # probabilistically, B3 admits the error class only. The
+            # verdict precedes every parse/queue path so a shed costs
+            # one substring probe, and the refusal is explicit — the
+            # sender gets a retryable rejection, never a dropped ack.
+            from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
+
+            admitted, cls = ctl.admit_ingest(data)
+            if not admitted:
+                self.metrics.increment_messages_dropped()
+                raise IngestBackpressure(
+                    f"overload {ctl.level_name}: {cls} payload shed; "
+                    "retry after the advertised backoff"
+                )
+        try:
+            # resource-exhaustion injection (faults.py): an allocation
+            # failure at the ingest boundary degrades to backpressure —
+            # the sender retries against a tier that is telling the
+            # truth about its memory — instead of crashing the server.
+            faults.resource_point("alloc")
+        except MemoryError as e:
+            from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
+
+            self.metrics.increment_messages_dropped()
+            raise IngestBackpressure(f"allocation failure: {e}") from e
         _MP = (codec.Encoding.JSON_V2, codec.Encoding.PROTO3)
         if (
             self.mp_ingester is not None

@@ -44,6 +44,29 @@ ZT_CRASHPOINT). Restore-time digest verification, generation fallback,
 and the background scrubber (runtime/scrub.py) are the recovery story
 these sites exist to prove.
 
+The ``resource`` family (ISSUE 13) models exhaustion rather than a
+crash or rot: the process keeps running but an operation fails (or
+slows) the way it does when a machine runs out of something. Sites
+name the operation whose resource ran out:
+
+- ``wal.append``   ENOSPC on the WAL record write
+- ``snapshot``     ENOSPC on the snapshot state/meta write
+- ``archive``      ENOSPC on the archive segment append
+- ``feed.latency`` injected latency on the device-feed dispatch
+- ``alloc``        allocation failure (MemoryError) on ingest staging
+
+Unlike crashpoints a resource fault is usually *sustained* — a full
+disk stays full — so arming takes a ``count``: the site starts firing
+on its ``nth`` traversal and keeps firing for ``count`` consecutive
+traversals before auto-clearing (space freed). ``count=0`` means fire
+until ``disarm()``. Armed via ``arm_resource(site, nth=..., count=...,
+latency_ms=...)`` or ``ZT_RESOURCE=<site>[:nth[:count]],...`` (plus
+``ZT_RESOURCE_LATENCY_MS`` for the latency site). The handling
+contract these sites exist to prove (tests/test_overload.py): disk
+exhaustion degrades to an explicitly-flagged at-risk mode with an SLO
+page — never a crash, never a silent ack — and clearing the fault
+restores normal operation with bit-identical query state.
+
 The disarmed fast path is one dict probe, so production code keeps the
 hooks compiled in; a site is one-shot — it disarms itself as it fires
 so crash/scrub *handling* code can re-enter the same path.
@@ -51,9 +74,11 @@ so crash/scrub *handling* code can re-enter the same path.
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 import signal
+import time
 from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
@@ -72,10 +97,19 @@ CORRUPT_SITES = (
     "archive.frame",
 )
 CORRUPT_MODES = ("flip", "truncate", "zero")
+RESOURCE_SITES = (
+    "wal.append",
+    "snapshot",
+    "archive",
+    "feed.latency",
+    "alloc",
+)
 
 ENV_VAR = "ZT_CRASHPOINT"
 ENV_ACTION = "ZT_CRASHPOINT_ACTION"
 ENV_CORRUPT = "ZT_CORRUPT"
+ENV_RESOURCE = "ZT_RESOURCE"
+ENV_RESOURCE_LATENCY = "ZT_RESOURCE_LATENCY_MS"
 EXIT_CODE = 137  # what a SIGKILL'd child reports; `exit` mimics it
 
 _ACTIONS = ("kill", "exit", "raise")
@@ -91,6 +125,8 @@ class CrashpointTriggered(RuntimeError):
 _armed: Dict[str, List] = {}
 # site -> [remaining_nth, mode]; mutated in place by corrupt_point()
 _corrupt_armed: Dict[str, List] = {}
+# site -> [remaining_nth, remaining_count, latency_s]; resource_point()
+_resource_armed: Dict[str, List] = {}
 
 
 def arm(site: str, nth: int = 1, action: str = "kill") -> None:
@@ -116,9 +152,24 @@ def arm_corrupt(site: str, mode: str = "flip", nth: int = 1) -> None:
     _corrupt_armed[site] = [max(1, int(nth)), mode]
 
 
+def arm_resource(site: str, nth: int = 1, count: int = 1,
+                 latency_ms: float = 25.0) -> None:
+    """Arm a resource site: starts failing on its ``nth`` traversal and
+    keeps failing for ``count`` consecutive traversals (0 = until
+    ``disarm()``), modeling sustained exhaustion that later clears."""
+    if site not in RESOURCE_SITES:
+        raise ValueError(
+            f"unknown resource site {site!r} (see faults.RESOURCE_SITES)"
+        )
+    _resource_armed[site] = [
+        max(1, int(nth)), max(0, int(count)), max(0.0, latency_ms) / 1000.0
+    ]
+
+
 def disarm() -> None:
     _armed.clear()
     _corrupt_armed.clear()
+    _resource_armed.clear()
 
 
 def armed_site() -> Optional[str]:
@@ -134,6 +185,10 @@ def is_armed(site: str) -> bool:
 
 def is_corrupt_armed(site: str) -> bool:
     return site in _corrupt_armed
+
+
+def is_resource_armed(site: str) -> bool:
+    return site in _resource_armed
 
 
 def crashpoint(site: str) -> None:
@@ -190,6 +245,32 @@ def corrupt_point(site: str, path: str, start: int, length: int) -> bool:
     return True
 
 
+def resource_point(site: str) -> None:
+    """Hot-path hook for exhaustion sites. No-op (one dict probe)
+    unless armed. Disk sites raise ``OSError(ENOSPC)``, ``alloc``
+    raises ``MemoryError``, ``feed.latency`` sleeps and returns — the
+    caller's normal error handling IS the behavior under test."""
+    spec = _resource_armed.get(site)
+    if spec is None:
+        return
+    if spec[0] > 1:
+        spec[0] -= 1  # not yet at the nth traversal
+        return
+    if spec[1] > 0:
+        spec[1] -= 1
+        if spec[1] == 0:
+            del _resource_armed[site]  # exhaustion cleared (space freed)
+    if site == "feed.latency":
+        logger.warning("resource fault %s firing (sleep %.1f ms)",
+                       site, spec[2] * 1000.0)
+        time.sleep(spec[2])
+        return
+    logger.warning("resource fault %s firing", site)
+    if site == "alloc":
+        raise MemoryError(f"injected allocation failure at {site}")
+    raise OSError(errno.ENOSPC, f"injected ENOSPC at {site}")
+
+
 def _arm_from_env() -> None:
     raw = os.environ.get(ENV_VAR)
     if raw:
@@ -221,6 +302,28 @@ def _arm_from_env() -> None:
                 )
             except ValueError as e:
                 logger.warning("ignoring %s=%r: %s", ENV_CORRUPT, raw, e)
+    raw = os.environ.get(ENV_RESOURCE)
+    if raw:
+        try:
+            lat_ms = float(os.environ.get(ENV_RESOURCE_LATENCY, "25"))
+        except ValueError:
+            lat_ms = 25.0
+        for spec in raw.split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            parts = spec.split(":")
+            try:
+                arm_resource(
+                    parts[0].strip(),
+                    int(parts[1]) if len(parts) > 1 and parts[1].strip()
+                    else 1,
+                    int(parts[2]) if len(parts) > 2 and parts[2].strip()
+                    else 1,
+                    latency_ms=lat_ms,
+                )
+            except ValueError as e:
+                logger.warning("ignoring %s=%r: %s", ENV_RESOURCE, raw, e)
 
 
 _arm_from_env()

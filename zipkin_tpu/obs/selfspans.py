@@ -64,6 +64,14 @@ class SelfSpanEmitter:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.emitted = 0
+        # brownout gate (runtime/overload.py, ISSUE 13): a callable
+        # returning True when B1+ is shedding expensive observability.
+        # Gated events are counted and DROPPED — the slow ring and
+        # /statusz keep recording (they are cheap); only the span
+        # emission (a collector write competing with real traffic for
+        # the device) goes overboard.
+        self.gate = None
+        self.shed = 0
 
     # -- wiring --------------------------------------------------------
 
@@ -97,6 +105,10 @@ class SelfSpanEmitter:
             event["traceId"], event["parentId"] = ctx
         if getattr(self._suppress, "on", False):
             return
+        gate = self.gate
+        if gate is not None and gate():
+            self.shed += 1
+            return
         now = time.monotonic()
         stage = event["stage"]
         last = self._last_emit.get(stage, 0.0)
@@ -110,8 +122,14 @@ class SelfSpanEmitter:
 
         Bounded append only — safe from any thread; the drain thread
         publishes them under the same suppression guard as slow-stage
-        events, so the hand-off cannot re-trigger itself.
+        events, so the hand-off cannot re-trigger itself. Subject to
+        the same brownout gate as slow-stage events: under B1+ the
+        slowest-chunk timelines are shed, not queued.
         """
+        gate = self.gate
+        if gate is not None and gate():
+            self.shed += len(spans)
+            return
         for s in spans:
             self._prebuilt.append(s)
 

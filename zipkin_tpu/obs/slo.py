@@ -100,6 +100,14 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
                 bad="mpRejected", good="mpAccepted", **kw),
         SloSpec("snapshot_age", "gauge", gauge="snapshotAgeS",
                 limit=1800.0, **kw),
+        # Disk-exhaustion degraded mode (ISSUE 13): storage flips this
+        # 0/1 gauge the instant a durable tier (WAL append or snapshot
+        # commit) enters ENOSPC-degraded mode — acked spans are not
+        # crash-safe until a snapshot re-covers the gap, which is a
+        # page, not a dashboard curiosity. A 0/1 gauge against limit
+        # 1.0 makes the trip immediate and the clear exact.
+        SloSpec("durability_at_risk", "gauge", gauge="durabilityAtRisk",
+                limit=1.0, **kw),
         SloSpec("digest_p99_relerr", "gauge",
                 gauge="accuracyDigestP99Drift", limit=0.20, **kw),
         SloSpec("hll_relerr", "gauge",

@@ -327,6 +327,59 @@ class ZipkinServer:
                     self._obs_slo.on_trip.append(
                         self._obs_incidents.on_slo_trip
                     )
+        # overload control plane (runtime/overload.py, ISSUE 13): folds
+        # the published signals into the brownout ladder every telemetry
+        # tick. Constructed even without the windowed plane (tests and
+        # embedders drive evaluate() directly); when windows run, the
+        # controller subscribes AFTER the stitchers — it reads the
+        # gauges the same tick just folded.
+        self._overload = None
+        if self.config.overload_enabled:
+            from zipkin_tpu.runtime.overload import OverloadController
+
+            core = getattr(self.storage, "delegate", self.storage)
+            self._overload = OverloadController(
+                enter=(
+                    self.config.overload_enter_b1,
+                    self.config.overload_enter_b2,
+                    self.config.overload_enter_b3,
+                ),
+                exit_margin=self.config.overload_exit_margin,
+                dwell_ticks=self.config.overload_dwell_ticks,
+                max_stale_ms=self.config.overload_max_stale_ms,
+                retry_base_s=self.config.overload_retry_base_s,
+                # B2 bulk sheds nudge the sampling tier's pressure hook:
+                # sustained overload degrades into lower sampling rates
+                # instead of an ever-taller wall of 429s
+                rate_controller=getattr(core, "sampling_controller", None),
+            )
+            # ingest admission gate: the collector consults the ladder
+            # before any parse or queue hand-off
+            self.collector.overload = self._overload
+            # read-mode seam: the store's cached-read path serves
+            # cache-first (B1/B2) / cache-only (B3) within the stated
+            # staleness bound
+            core.overload = self._overload
+            # B1 observability shed: self-spans and slowest-chunk
+            # timelines are the first cargo overboard
+            if self._obs_emitter is not None:
+                self._obs_emitter.gate = self._overload.shed_observability
+            if self._obs_windows is not None:
+                self._obs_windows.on_tick(self._overload.on_tick)
+            # every ladder transition is an incident: capture the flight
+            # around the brownout before the volatile planes rotate
+            if self._obs_incidents is not None:
+                self._obs_incidents.add_source(
+                    "overload", self._overload.status
+                )
+                rec = self._obs_incidents
+                self._overload.on_transition.append(
+                    lambda ev: rec.capture({
+                        "kind": "overload_transition",
+                        "name": f"overload-{ev['from']}-to-{ev['to']}",
+                        **ev,
+                    })
+                )
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
         self._grpc = None
@@ -337,6 +390,10 @@ class ZipkinServer:
 
     def make_app(self) -> web.Application:
         app = web.Application(client_max_size=64 * 1024 * 1024)
+        if self.config.deadline_propagation_enabled:
+            # outermost: stamp the caller's X-Request-Timeout-Ms budget
+            # before any other middleware spends time on the request
+            app.middlewares.append(self._deadline_middleware)
         if self.config.self_tracing_enabled:
             from zipkin_tpu.server.self_tracing import self_tracing_middleware
 
@@ -422,24 +479,29 @@ class ZipkinServer:
         if self.config.grpc_collector_enabled:
             from zipkin_tpu.server.grpc import GrpcCollectorServer
 
+            grpc_collector = Collector(
+                self.storage,
+                sampler=self.collector.sampler,
+                metrics=self.metrics.for_transport("grpc"),
+                # without this the gRPC tier decodes proto3 on the
+                # Python object path (~15k spans/s measured) while
+                # HTTP rides the native parser — the r4 "line-rate
+                # gRPC" claim depends on the fast path here too
+                fast_ingest=self.config.tpu_fast_ingest,
+                # SpanService/Report routes into the SAME parse
+                # fan-out as HTTP (ISSUE 8): proto3 is the tier's
+                # preferred wire, not the odd one out
+                mp_ingester=self._mp_ingester,
+                shadow=self._obs_shadow,
+            )
+            # same brownout admission as HTTP: the ladder must not have
+            # a transport-shaped hole in it
+            grpc_collector.overload = self._overload
             self._grpc = GrpcCollectorServer(
-                Collector(
-                    self.storage,
-                    sampler=self.collector.sampler,
-                    metrics=self.metrics.for_transport("grpc"),
-                    # without this the gRPC tier decodes proto3 on the
-                    # Python object path (~15k spans/s measured) while
-                    # HTTP rides the native parser — the r4 "line-rate
-                    # gRPC" claim depends on the fast path here too
-                    fast_ingest=self.config.tpu_fast_ingest,
-                    # SpanService/Report routes into the SAME parse
-                    # fan-out as HTTP (ISSUE 8): proto3 is the tier's
-                    # preferred wire, not the odd one out
-                    mp_ingester=self._mp_ingester,
-                    shadow=self._obs_shadow,
-                ),
+                grpc_collector,
                 host=self.config.host,
                 port=self.config.grpc_port,
+                deadlines=self.config.deadline_propagation_enabled,
             )
             await self._grpc.start()
         if self.config.scribe_enabled:
@@ -537,6 +599,52 @@ class ZipkinServer:
                 logger.exception("shutdown snapshot failed")
         self.storage.close()
 
+    # -- deadlines + backoff guidance (ISSUE 13) ---------------------------
+
+    @web.middleware
+    async def _deadline_middleware(self, request, handler):
+        """Stamp the caller's ``X-Request-Timeout-Ms`` budget at the
+        earliest server-side instant; handlers check it right before
+        their expensive dispatch. gRPC carries the same contract via
+        its native deadline (``context.time_remaining``)."""
+        raw = request.headers.get("X-Request-Timeout-Ms")
+        if raw:
+            try:
+                budget_ms = float(raw)
+            except ValueError:
+                budget_ms = None  # malformed header: no deadline
+            if budget_ms is not None:
+                request["deadline_mono"] = (
+                    time.monotonic() + max(0.0, budget_ms) / 1000.0
+                )
+        return await handler(request)
+
+    def _deadline_expired(self, request) -> Optional[web.Response]:
+        """504 when the caller's budget is already spent — counted on
+        the controller so ``deadlineExpired`` surfaces on /metrics."""
+        deadline = request.get("deadline_mono")
+        if deadline is None or time.monotonic() <= deadline:
+            return None
+        if self._overload is not None:
+            self._overload.note_deadline_expired()
+        return web.Response(
+            status=504,
+            text="deadline expired before dispatch",
+            headers={"X-Deadline-Expired": "1"},
+        )
+
+    def _backoff_headers(self) -> Dict[str, str]:
+        """Retry guidance for a shed: jittered delay from the live load
+        index. ``Retry-After`` is RFC delta-seconds (integer, so ceil);
+        ``X-Retry-After-Ms`` preserves the jitter's precision."""
+        if self._overload is None:
+            return {}
+        delay_s = self._overload.retry_after_s()
+        return {
+            "Retry-After": str(max(1, int(-(-delay_s // 1)))),
+            "X-Retry-After-Ms": str(int(delay_s * 1000.0)),
+        }
+
     # -- ingest ------------------------------------------------------------
 
     MAX_INFLATED = 256 * 1024 * 1024  # decompression-bomb guard
@@ -593,6 +701,13 @@ class ZipkinServer:
         elif ctype == JSON and v1:
             encoding = Encoding.JSON_V1
         # else: sniff (covers missing/odd content types)
+        # deadline propagation (ISSUE 13): the caller's budget may have
+        # expired while the body was read — work already past its
+        # deadline must be dropped BEFORE the collector dispatches it,
+        # or an overloaded tier burns capacity on answers nobody awaits
+        expired = self._deadline_expired(request)
+        if expired is not None:
+            return expired
         try:
             await asyncio.to_thread(self.collector.accept_spans_bytes, body, encoding)
         except ValueError as e:
@@ -602,10 +717,16 @@ class ZipkinServer:
             # (reference behavior for RejectedExecutionException)
             return web.Response(status=503, text=str(e))
         except IngestBackpressure as e:
-            # every parse-worker queue in the fan-out tier is full: 429
-            # (Too Many Requests) — transient, retryable, distinct from
-            # the throttle's 503 so dashboards can tell the tiers apart
-            return web.Response(status=429, text=str(e))
+            # every parse-worker queue in the fan-out tier is full, or
+            # the brownout ladder shed the payload: 429 (Too Many
+            # Requests) — transient, retryable, distinct from the
+            # throttle's 503 so dashboards can tell the tiers apart.
+            # Retry-After carries the controller's jittered backoff
+            # (RFC delta-seconds, so ceil); the millisecond twin keeps
+            # the jitter visible to clients that want to decorrelate.
+            return web.Response(
+                status=429, text=str(e), headers=self._backoff_headers()
+            )
         # body read → collector hand-off complete; the 202 ack follows
         obs.record("http_boundary", time.perf_counter() - t0)
         return web.Response(status=202)
@@ -640,6 +761,9 @@ class ZipkinServer:
             query = self._parse_query(request)
         except ValueError as e:
             return web.Response(status=400, text=str(e))
+        expired = self._deadline_expired(request)
+        if expired is not None:
+            return expired
         traces = await asyncio.to_thread(
             lambda: self.storage.span_store().get_traces_query(query).execute()
         )
@@ -653,6 +777,9 @@ class ZipkinServer:
             normalize_trace_id(raw_id)
         except ValueError as e:
             return web.Response(status=400, text=str(e))
+        expired = self._deadline_expired(request)
+        if expired is not None:
+            return expired
         spans = await asyncio.to_thread(
             lambda: self.storage.span_store().get_trace(raw_id).execute()
         )
@@ -665,6 +792,9 @@ class ZipkinServer:
         ids = [x for x in raw.split(",") if x]
         if not ids:
             return web.Response(status=400, text="traceIds parameter is required")
+        expired = self._deadline_expired(request)
+        if expired is not None:
+            return expired
         traces = await asyncio.to_thread(
             lambda: self.storage.traces().get_traces(ids).execute()
         )
@@ -705,6 +835,9 @@ class ZipkinServer:
             lookback = int(request.query.get("lookback") or self.config.default_lookback)
         except ValueError as e:
             return web.Response(status=400, text=str(e))
+        expired = self._deadline_expired(request)
+        if expired is not None:
+            return expired
         links = await asyncio.to_thread(
             lambda: self.storage.span_store().get_dependencies(end_ts, lookback).execute()
         )
@@ -745,6 +878,9 @@ class ZipkinServer:
             lookback = int(lookback) if lookback is not None else None
         except ValueError as e:
             return web.Response(status=400, text=str(e))
+        expired = self._deadline_expired(request)
+        if expired is not None:
+            return expired
         rows = await asyncio.to_thread(
             self.storage.latency_quantiles,
             qs,
@@ -781,6 +917,9 @@ class ZipkinServer:
                 raise ValueError(f"q out of range: {raw_q!r}")
         except ValueError as e:
             return web.Response(status=400, text=str(e))
+        expired = self._deadline_expired(request)
+        if expired is not None:
+            return expired
         body = await asyncio.to_thread(
             self.storage.sketch_overview,
             qs,
@@ -972,6 +1111,11 @@ class ZipkinServer:
                 out[f"{base}.alert"] = int(v["alert"])
                 for wname, wv in v["windows"].items():
                     out[f"{base}.burn.{wname}"] = wv["burn"]
+        # overload control plane (ISSUE 13): ladder level, load index,
+        # per-class admit/shed tallies, deadline drops
+        if self._overload is not None:
+            for name, value in self._overload.counters().items():
+                out[f"gauge.zipkin_tpu.{name}"] = value
         return web.json_response(out)
 
     async def get_prometheus(self, request: web.Request) -> web.Response:
@@ -1046,6 +1190,11 @@ class ZipkinServer:
             lines.extend(
                 _prom_slo(await asyncio.to_thread(self._obs_slo.verdicts))
             )
+        # overload control plane (ISSUE 13): zipkin_tpu_overload_*
+        # families — ladder posture, the folded signal set, admission
+        # accounting, and deadline drops
+        if self._overload is not None:
+            lines.extend(_prom_overload(self._overload.status()))
         return web.Response(text="\n".join(lines) + "\n")
 
     async def get_tpu_statusz(self, request: web.Request) -> web.Response:
@@ -1132,6 +1281,10 @@ class ZipkinServer:
             body["queries"] = await asyncio.to_thread(
                 self._querytrace.waterfall
             )
+        # overload control plane (ISSUE 13): ladder state, the live
+        # signal fold, admission posture, and the transition history
+        if self._overload is not None:
+            body["overload"] = self._overload.status()
         if self._obs_incidents is not None:
             body["incidents"] = self._obs_incidents.counters()
         return web.json_response(body)
@@ -1444,6 +1597,60 @@ def _prom_query_segments(segments) -> List[str]:
                 f'{fam}{{segment="{_prom_label(seg)}",'
                 f'kind="{_prom_label(row["kind"])}"}} {row[field]}'
             )
+    return lines
+
+
+def _prom_overload(status) -> List[str]:
+    """Overload control plane families (ISSUE 13). Scalars carry the
+    ladder posture; the per-signal family shows WHICH bottleneck is
+    driving the load index (it is a MAX fold, so exactly one signal is
+    the story at any instant)."""
+    lines: List[str] = []
+    gauges = (
+        ("level", status["level"],
+         "Brownout ladder level (0=B0 normal .. 3=B3 essential-only)"),
+        ("load_index", status["loadIndex"],
+         "EMA-smoothed load index (max-folded signal pressure)"),
+        ("raw_load_index", status["rawLoadIndex"],
+         "Unsmoothed load index from the latest tick"),
+        ("bulk_admit_p", status["bulkAdmitP"],
+         "Bulk-class ingest admit probability (1.0 outside B2)"),
+    )
+    for suffix, value, help_text in gauges:
+        fam = f"zipkin_tpu_overload_{suffix}"
+        lines.append(f"# HELP {fam} {help_text}.")
+        lines.append(f"# TYPE {fam} gauge")
+        lines.append(f"{fam} {value}")
+    signals = status.get("signals") or {}
+    if signals:
+        fam = "zipkin_tpu_overload_signal"
+        lines.append(
+            f"# HELP {fam} Per-signal pressure ratio "
+            "(value over design limit; 1.0 = at the limit)."
+        )
+        lines.append(f"# TYPE {fam} gauge")
+        for name, value in sorted(signals.items()):
+            lines.append(
+                f'{fam}{{signal="{_prom_label(name)}"}} {value}'
+            )
+    counters = status.get("counters") or {}
+    counter_fields = (
+        ("admitted", "admitted_total", "payloads admitted"),
+        ("admittedEssential", "admitted_essential_total",
+         "error-class payloads admitted under brownout"),
+        ("shedBulk", "shed_bulk_total", "bulk-class payloads shed"),
+        ("shedTotal", "shed_total", "payloads shed"),
+        ("deadlineExpired", "deadline_expired_total",
+         "requests dropped already past their deadline"),
+        ("transitions", "transitions_total", "ladder level transitions"),
+    )
+    for field, suffix, help_text in counter_fields:
+        if field not in counters:
+            continue
+        fam = f"zipkin_tpu_overload_{suffix}"
+        lines.append(f"# HELP {fam} Overload controller: {help_text}.")
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam} {counters[field]}")
     return lines
 
 

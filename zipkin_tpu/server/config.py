@@ -137,8 +137,33 @@ class ServerConfig:
     tpu_mp_workers: int = 0  # >0: multi-process parse tier (mp_ingest)
     # per-worker payload bound of the fan-out tier's queues: when every
     # live worker's queue is full the boundary answers HTTP 429 / gRPC
-    # RESOURCE_EXHAUSTED instead of buffering unboundedly
+    # RESOURCE_EXHAUSTED (carrying Retry-After / retry-delay backoff
+    # guidance from the overload controller — queue-full rejection is
+    # the LAST backpressure surface, behind brownout admission and
+    # sampling-budget tightening; see runtime/overload.py)
     tpu_mp_queue_depth: int = 2
+    # overload control plane (runtime/overload.py, ISSUE 13): folds the
+    # published pressure signals into a hysteretic load index driving
+    # the B0->B3 brownout ladder — B1 sheds expensive observability and
+    # serves reads cache-first within TPU_OVERLOAD_MAX_STALE_MS, B2
+    # sheds bulk ingest probabilistically (error-class traffic always
+    # admits) and tightens the sampling budget, B3 serves cached-only
+    # reads and essential ingest only. Thresholds are the ladder's
+    # enter edges; exit subtracts TPU_OVERLOAD_EXIT_MARGIN with a
+    # TPU_OVERLOAD_DWELL_TICKS minimum dwell (hysteresis).
+    overload_enabled: bool = True
+    overload_enter_b1: float = 0.70
+    overload_enter_b2: float = 0.85
+    overload_enter_b3: float = 0.95
+    overload_exit_margin: float = 0.10
+    overload_dwell_ticks: int = 5
+    overload_max_stale_ms: int = 5000
+    overload_retry_base_s: float = 0.25
+    # deadline propagation (ISSUE 13): honor gRPC deadlines and the
+    # X-Request-Timeout-Ms HTTP header at ingest + query entrypoints —
+    # work already past its deadline is dropped before device dispatch
+    # (counted deadlineExpired, never dispatched)
+    deadline_propagation_enabled: bool = True
     # one-knob durable boot (ISSUE 3): TPU_RESUME_DIR=<dir> defaults
     # checkpoint/WAL/archive under <dir>/{snap,wal,archive} so boot runs
     # the full restore sequence — snapshot restore, WAL-tail replay,
@@ -268,6 +293,17 @@ class ServerConfig:
             tpu_fast_archive_sample=_env_int("TPU_FAST_ARCHIVE_SAMPLE", 64),
             tpu_mp_workers=_env_int("TPU_MP_WORKERS", 0),
             tpu_mp_queue_depth=_env_int("TPU_MP_QUEUE_DEPTH", 2),
+            overload_enabled=_env_bool("TPU_OVERLOAD", True),
+            overload_enter_b1=_env_float("TPU_OVERLOAD_ENTER_B1", 0.70),
+            overload_enter_b2=_env_float("TPU_OVERLOAD_ENTER_B2", 0.85),
+            overload_enter_b3=_env_float("TPU_OVERLOAD_ENTER_B3", 0.95),
+            overload_exit_margin=_env_float("TPU_OVERLOAD_EXIT_MARGIN", 0.10),
+            overload_dwell_ticks=_env_int("TPU_OVERLOAD_DWELL_TICKS", 5),
+            overload_max_stale_ms=_env_int("TPU_OVERLOAD_MAX_STALE_MS", 5000),
+            overload_retry_base_s=_env_float(
+                "TPU_OVERLOAD_RETRY_BASE_S", 0.25
+            ),
+            deadline_propagation_enabled=_env_bool("TPU_DEADLINES", True),
             tpu_resume_dir=resume_dir,
             tpu_checkpoint_dir=os.environ.get("TPU_CHECKPOINT_DIR")
             or (os.path.join(resume_dir, "snap") if resume_dir else None),

@@ -61,8 +61,23 @@ def _stamped_request(data: bytes):
 
 
 class _SpanServiceHandler(grpc.GenericRpcHandler):
-    def __init__(self, collector: Collector) -> None:
+    def __init__(self, collector: Collector, deadlines: bool = True) -> None:
         self._collector = collector
+        self._deadlines = deadlines
+
+    def _retry_trailers(self):
+        """Backoff guidance for a RESOURCE_EXHAUSTED shed (ISSUE 13):
+        the overload controller's jittered delay as ``retry-delay``
+        trailing metadata (seconds, decimal) — the gRPC twin of the
+        HTTP site's Retry-After header."""
+        ctl = getattr(self._collector, "overload", None)
+        if ctl is None:
+            return None
+        delay_s = ctl.retry_after_s()
+        return (
+            ("retry-delay", f"{delay_s:.3f}s"),
+            ("retry-delay-ms", str(int(delay_s * 1000.0))),
+        )
 
     def service(self, handler_call_details):
         if handler_call_details.method != METHOD:
@@ -71,6 +86,20 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
         async def report(request, context) -> bytes:
             t0_ns, data = request
             critpath.WIRE_T0_NS.set(t0_ns)
+            # deadline propagation (ISSUE 13): the client's gRPC
+            # deadline may already be spent (the message sat in HTTP/2
+            # reassembly or the accept queue) — drop before the
+            # collector dispatches work nobody awaits
+            if self._deadlines:
+                remaining = context.time_remaining()
+                if remaining is not None and remaining <= 0:
+                    ctl = getattr(self._collector, "overload", None)
+                    if ctl is not None:
+                        ctl.note_deadline_expired()
+                    await context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        "deadline expired before dispatch",
+                    )
             md = dict(context.invocation_metadata() or ())
             tid, sid = md.get("x-b3-traceid"), md.get("x-b3-spanid")
             sampled = str(md.get("x-b3-sampled", "")).lower()
@@ -87,8 +116,13 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except Exception as e:
                 # storage rejection -> retryable; IngestBackpressure (the
-                # fan-out tier's bounded queues are full) lands here too,
-                # the gRPC twin of the HTTP site's 429
+                # fan-out tier's bounded queues are full, or the brownout
+                # ladder shed the payload) lands here too, the gRPC twin
+                # of the HTTP site's 429 — trailing metadata carries the
+                # controller's backoff guidance
+                trailers = self._retry_trailers()
+                if trailers is not None:
+                    context.set_trailing_metadata(trailers)
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             finally:
                 if token is not None:
@@ -108,11 +142,13 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
 class GrpcCollectorServer:
     """Lifecycle wrapper: bind, serve, drain."""
 
-    def __init__(self, collector: Collector, host: str = "0.0.0.0", port: int = 9412):
+    def __init__(self, collector: Collector, host: str = "0.0.0.0",
+                 port: int = 9412, deadlines: bool = True):
         self._collector = collector
         self._address = f"{host}:{port}"
         self._server: Optional[grpc.aio.Server] = None
         self.port = port
+        self._deadlines = deadlines
 
     async def start(self) -> "GrpcCollectorServer":
         # span batches are big by design (a 64k-span ListOfSpans is
@@ -121,7 +157,9 @@ class GrpcCollectorServer:
             ("grpc.max_receive_message_length", 64 << 20),
             ("grpc.max_send_message_length", 64 << 20),
         ])
-        server.add_generic_rpc_handlers((_SpanServiceHandler(self._collector),))
+        server.add_generic_rpc_handlers(
+            (_SpanServiceHandler(self._collector, self._deadlines),)
+        )
         self.port = server.add_insecure_port(self._address)
         await server.start()
         self._server = server

@@ -82,6 +82,11 @@ class TpuStorage(_CoreTpuStorage):
         # durability-lag gauge: age of the last persisted generation
         # (boot counts as the epoch until the first snapshot lands)
         self._last_snapshot_mono = time.monotonic()
+        # disk-exhaustion degraded mode (ISSUE 13): an ENOSPC snapshot
+        # save is dropped (prior generations stay intact) and retried on
+        # the next cycle; the flag feeds the durability_at_risk SLO page
+        self._snapshot_at_risk = False
+        self._snapshot_enospc = 0
         # boot restore/replay must not re-gate: WAL batches were compacted
         # to kept lanes at log time and replay restores the exact sampler
         # counters from record meta — a second verdict pass would re-drop
@@ -194,15 +199,38 @@ class TpuStorage(_CoreTpuStorage):
             t0 = time.perf_counter()
             # ledger attribution: the save holds the aggregator lock
             # while it reads device state out for persistence
-            with querytrace.lock_label("snapshot"):
-                path = save(
-                    self, self.checkpoint_dir, keep=self.snapshot_keep
-                )
+            try:
+                with querytrace.lock_label("snapshot"):
+                    path = save(
+                        self, self.checkpoint_dir, keep=self.snapshot_keep
+                    )
+            except OSError as e:
+                import errno as _errno
+
+                if e.errno != _errno.ENOSPC:
+                    raise
+                # degraded, not dead: the commit protocol renames only
+                # after a complete write, so every retained generation
+                # is still intact — flag at-risk (snapshotAgeS keeps
+                # climbing into its SLO) and retry next cycle
+                self._snapshot_enospc += 1
+                if not self._snapshot_at_risk:
+                    logger.error(
+                        "snapshot save hit ENOSPC: durability AT RISK "
+                        "(retained generations intact; retrying next "
+                        "cycle)"
+                    )
+                self._snapshot_at_risk = True
+                return None
             wal = getattr(self, "wal", None)
             if wal is not None:
                 covered = retained_coverage(self.checkpoint_dir)
                 if covered is not None:
                     wal.truncate_covered(covered)
+                # full state just became durable: an ENOSPC-missed WAL
+                # window no longer threatens acked spans
+                wal.clear_at_risk()
+            self._snapshot_at_risk = False
             obs.record("snapshot", time.perf_counter() - t0)
             self._last_snapshot_mono = time.monotonic()
         return path
@@ -215,6 +243,19 @@ class TpuStorage(_CoreTpuStorage):
             counters["snapshotAgeS"] = round(
                 time.monotonic() - self._last_snapshot_mono, 3
             )
+        counters["snapshotEnospc"] = self._snapshot_enospc
+        wal = getattr(self, "wal", None)
+        if wal is not None:
+            counters["walEnospc"] = wal.enospc_count
+            counters["walMissedRecords"] = wal.missed_records
+        # the durability_at_risk SLO page keys off this single gauge:
+        # 1 whenever ANY durable tier is in ENOSPC-degraded mode
+        # (archive at-risk is excluded — a lossy cache dropping batches
+        # is degraded service, not an acked-durability breach)
+        counters["durabilityAtRisk"] = int(
+            self._snapshot_at_risk
+            or (wal is not None and wal.at_risk)
+        )
         return counters
 
     def close(self) -> None:

@@ -41,6 +41,7 @@ JSON bytes.
 
 from __future__ import annotations
 
+import errno
 import logging
 import os
 import struct
@@ -343,6 +344,12 @@ class SpanArchive:
         # went with them — searches skip them instead of failing
         self.segments_quarantined = 0
         self.spans_quarantined = 0
+        # disk-exhaustion accounting (ISSUE 13): the archive is a
+        # bounded lossy cache, so ENOSPC means drop-and-flag, not crash;
+        # at_risk clears on the next successful append (space freed)
+        self.enospc_count = 0
+        self.spans_dropped_enospc = 0
+        self.at_risk = False
         self._recover()
 
     # -- write side ------------------------------------------------------
@@ -390,18 +397,26 @@ class SpanArchive:
         with self._lock:
             if self._closed:
                 raise RuntimeError("archive is closed")
-            fh = self._live_file()
-            base = self._live_bytes + _FRAME.size + rows.nbytes
-            # offsets become absolute within the segment's data file
-            rows[:, 4] += np.uint32(base)
-            fh.write(frame)
-            fh.write(rows.tobytes())
-            if faults.is_armed("archive.mid_segment"):
-                fh.flush()  # kernel-visible partial frame for the
-                # in-process crash action (matches a post-flush SIGKILL)
-            faults.crashpoint("archive.mid_segment")
-            fh.write(payload)
-            fh.flush()
+            try:
+                faults.resource_point("archive")
+                fh = self._live_file()
+                base = self._live_bytes + _FRAME.size + rows.nbytes
+                # offsets become absolute within the segment's data file
+                rows[:, 4] += np.uint32(base)
+                fh.write(frame)
+                fh.write(rows.tobytes())
+                if faults.is_armed("archive.mid_segment"):
+                    fh.flush()  # kernel-visible partial frame for the
+                    # in-process crash action (matches post-flush SIGKILL)
+                faults.crashpoint("archive.mid_segment")
+                fh.write(payload)
+                fh.flush()
+            except OSError as e:
+                if e.errno != errno.ENOSPC:
+                    raise
+                self._note_enospc_locked(n)
+                return
+            self.at_risk = False
             # bit-rot injection site (ISSUE 7): the frame's payload is
             # durable — damage it at rest (scrub/recovery must catch it)
             faults.corrupt_point(
@@ -413,6 +428,34 @@ class SpanArchive:
             if self._live_bytes >= self.segment_bytes:
                 self._seal_live()
                 self._enforce_retention()
+
+    # zt-lint: disable=ZT04 — called only from append_batch's critical
+    # section; self._lock is already held
+    def _note_enospc_locked(self, n: int) -> None:
+        """Disk full mid-frame: drop the batch and ABANDON the live
+        segment — its file may carry a torn frame tail whose bytes the
+        row index never saw, and the seal sidecars need disk we don't
+        have. Already-indexed live rows go down with it (counted); boot
+        recovery truncates the orphan's torn tail if it survives."""
+        self.enospc_count += 1
+        self.spans_dropped_enospc += n + sum(
+            int(r.shape[0]) for r in self._live_rows
+        )
+        if not self.at_risk:
+            logger.error(
+                "archive append hit ENOSPC: raw-span archive degraded "
+                "(batches dropped until disk frees)"
+            )
+        self.at_risk = True
+        if self._live_fh is not None:
+            try:
+                self._live_fh.close()
+            except OSError:
+                pass
+            self._live_fh = None
+        self._live_path = None
+        self._live_bytes = 0
+        self._live_rows = []
 
     # zt-lint: disable=ZT04 — called only from append_batch's critical
     # section; self._lock is already held
@@ -750,6 +793,9 @@ class SpanArchive:
                 "archiveSearchSegmentsSkipped": self.segments_skipped,
                 "archiveSegmentsQuarantined": self.segments_quarantined,
                 "archiveSpansQuarantined": self.spans_quarantined,
+                "archiveEnospc": self.enospc_count,
+                "archiveSpansDroppedEnospc": self.spans_dropped_enospc,
+                "archiveAtRisk": int(self.at_risk),
                 "archiveSegments": len(self._sealed)
                 + (1 if self._live_rows else 0),
                 "archiveBytes": sum(s.bytes_used() for s in self._sealed)

@@ -28,7 +28,13 @@ Backpressure contract: each worker owns a BOUNDED queue. ``submit(...,
 block=False)`` — the server-boundary mode — raises
 :class:`IngestBackpressure` when every live worker's queue is full; the
 HTTP site maps it to 429 and the gRPC site to RESOURCE_EXHAUSTED so
-senders back off instead of the tier buffering unboundedly.
+senders back off instead of the tier buffering unboundedly. Since
+ISSUE 13 the queue-full rejection is the LAST backpressure surface,
+not the only one: the overload control plane (runtime/overload.py)
+sheds bulk-class payloads at the collector boundary before they reach
+these queues (B2/B3 brownout admission), tightens the sampling tier's
+budget under sustained pressure, and stamps every rejection with
+jittered backoff guidance (``Retry-After`` / ``retry-delay``).
 
 Zero-loss worker death: the dispatcher retains every submitted payload
 (``_pending``) until its results are APPLIED, and buffers per-payload
@@ -63,7 +69,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-from zipkin_tpu import obs
+from zipkin_tpu import faults, obs
 from zipkin_tpu.obs import critpath as _critpath
 
 logger = logging.getLogger(__name__)
@@ -75,10 +81,13 @@ _KIND_EOF = 2
 
 
 class IngestBackpressure(RuntimeError):
-    """Every live parse worker's queue is full: the fan-out tier is
-    saturated. Raised by ``submit(..., block=False)``; the server
-    boundary maps it to HTTP 429 / gRPC RESOURCE_EXHAUSTED so senders
-    back off and retry instead of the tier buffering unboundedly."""
+    """The ingest tier refused a payload it could not absorb: every
+    live parse worker's queue is full (``submit(..., block=False)``),
+    the brownout ladder shed it (collector admission, ISSUE 13), or an
+    injected allocation failure fired. The server boundary maps it to
+    HTTP 429 / gRPC RESOURCE_EXHAUSTED — with the overload
+    controller's jittered backoff guidance attached — so senders back
+    off and retry instead of the tier buffering unboundedly."""
 
 
 def _extract_archive_slices(parsed, every: int) -> List[bytes]:
@@ -966,6 +975,11 @@ class MultiProcessIngester:
             if self.shadow is not None:
                 self.shadow.offer_fused(fused)
             tf0 = time.perf_counter()
+            # resource-fault injection (faults.py, ISSUE 13): an armed
+            # feed.latency site sleeps here — the exact seam where a
+            # slow device feed stalls the dispatcher — so overload
+            # tests can manufacture queue saturation deterministically
+            faults.resource_point("feed.latency")
             store.agg.ingest_fused(
                 fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
                 ts_range=ts_range,

@@ -212,6 +212,10 @@ def save(
 
     gen = _next_generation(directory)
     state_name = f"{_STATE_PREFIX}{gen:08d}.npz"
+    # disk-exhaustion site (ISSUE 13): fires BEFORE any rename, so an
+    # ENOSPC save leaves every retained generation intact — the caller
+    # (storage/tpu.py) flags durability at-risk and retries next cycle
+    faults.resource_point("snapshot")
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     with os.fdopen(fd, "wb") as f:  # file object: savez won't append ".npz"
         np.savez_compressed(f, **arrays)

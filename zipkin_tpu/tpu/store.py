@@ -259,6 +259,16 @@ class TpuStorage(
         # answers are also young; these gauges put a number on it
         self._read_cache_age_ms = 0.0
         self._read_cache_age_max_ms = 0.0
+        # overload control plane (runtime/overload.py, ISSUE 13): the
+        # server wires its brownout controller here. Under B1/B2 the
+        # cached-read path serves CACHE-FIRST — a version-stale entry
+        # within the controller's staleness bound beats a device pull
+        # that would queue behind a saturated ingest lock; under B3 any
+        # cached answer serves (cache-only). Stale serves are counted
+        # so "the queries stayed fast" can be audited against "and this
+        # many answers were seconds old".
+        self.overload = None
+        self._read_cache_stale_serves = 0
         # dependency answers additionally tolerate BOUNDED STALENESS
         # under sustained ingest (env TPU_DEPS_MAX_STALE_MS, default 5s;
         # 0 = always fresh): the reference's dependency table is written
@@ -1017,27 +1027,48 @@ class TpuStorage(
         read-triggered flush keeps every cached answer valid. The whole
         cache drops when the version advances — keys embed window
         minutes and quantile lists, so per-key staleness checks alone
-        would let dead entries accumulate forever under a polling UI."""
+        would let dead entries accumulate forever under a polling UI.
+
+        Brownout read modes (runtime/overload.py, ISSUE 13): under
+        B1/B2 (``cache_first``) a version-stale entry still serves if
+        younger than the controller's staleness bound — the device pull
+        it avoids would queue behind a saturated ingest lock; under B3
+        (``cache_only``) any cached answer serves. Entries carry the
+        write version they were computed at, so the staleness of every
+        serve is exact; a cold key still computes (serving an error
+        would turn a brownout into an outage for first-touch queries),
+        and the first normal-mode read after recovery drops every
+        stale entry wholesale."""
         t0 = time.perf_counter()
         t0_ns = time.perf_counter_ns()
         version = self.agg.write_version
+        ctl = self.overload
+        mode = ctl.read_mode() if ctl is not None else "normal"
         with self._read_cache_lock:
-            if self._read_cache_version != version:
+            if mode == "normal" and self._read_cache_version != version:
                 self._read_cache.clear()
                 self._read_cache_version = version
             hit = self._read_cache.get(key)
             if hit is not None:
-                value, born = hit
+                value, born, born_version = hit
                 age_ms = (time.monotonic() - born) * 1000.0
-                self._read_cache_age_ms = age_ms
-                if age_ms > self._read_cache_age_max_ms:
-                    self._read_cache_age_max_ms = age_ms
-                obs.record("query_cached", time.perf_counter() - t0)
-                querytrace.stamp_active(
-                    querytrace.QSEG_CACHE_PROBE, t0_ns,
-                    time.perf_counter_ns(),
+                fresh = born_version == version
+                serve = fresh or mode == "cache_only" or (
+                    mode == "cache_first"
+                    and age_ms <= ctl.max_stale_ms
                 )
-                return value
+                if serve:
+                    if not fresh:
+                        self._read_cache_stale_serves += 1
+                    self._read_cache_age_ms = age_ms
+                    if age_ms > self._read_cache_age_max_ms:
+                        self._read_cache_age_max_ms = age_ms
+                    obs.record("query_cached", time.perf_counter() - t0)
+                    querytrace.stamp_active(
+                        querytrace.QSEG_CACHE_PROBE, t0_ns,
+                        time.perf_counter_ns(),
+                    )
+                    return value
         # the probe segment ends where compute() begins — on a miss the
         # rest of the wall belongs to dispatch/transfer/unpack stamps
         querytrace.stamp_active(
@@ -1046,8 +1077,8 @@ class TpuStorage(
         value = compute()
         obs.record("query_fresh", time.perf_counter() - t0)
         with self._read_cache_lock:
-            if self._read_cache_version == version:
-                self._read_cache[key] = (value, time.monotonic())
+            if mode != "normal" or self._read_cache_version == version:
+                self._read_cache[key] = (value, time.monotonic(), version)
         return value
 
     def invalidate_read_cache(self) -> None:
@@ -1409,6 +1440,9 @@ class TpuStorage(
             "readCacheServeAgeMs": round(self._read_cache_age_ms, 3),
             "readCacheServeAgeMaxMs": round(self._read_cache_age_max_ms, 3),
             "readCacheEntries": len(self._read_cache),
+            # brownout cache-first/cache-only serves (ISSUE 13):
+            # version-stale answers served under overload read modes
+            "readCacheStaleServes": self._read_cache_stale_serves,
         }
 
     def set_query_observatory(self, on: bool) -> None:

@@ -29,6 +29,7 @@ parity after crash+replay is asserted in tests/test_wal.py.
 
 from __future__ import annotations
 
+import errno
 import json
 import logging
 import os
@@ -65,6 +66,15 @@ class WriteAheadLog:
         self._seg_idx = 0
         self._seq = 0
         self._closed = False
+        # disk-exhaustion degraded mode (ISSUE 13): an ENOSPC append
+        # does NOT crash the ingest path — the record is missed, the log
+        # flags itself at-risk (acked spans between here and the next
+        # durable snapshot would not survive a crash), and the flag
+        # stays sticky until a snapshot re-covers the full state
+        # (storage/tpu.py calls clear_at_risk() after a committed save)
+        self.at_risk = False
+        self.enospc_count = 0
+        self.missed_records = 0
         # resume numbering after the existing records — via a HEADER
         # walk, not records(): records() stops at the first bad payload
         # crc, so mid-segment rot would hide the seq high-water mark and
@@ -96,34 +106,42 @@ class WriteAheadLog:
             zlib.crc32(payload),
         )
         rec_len = len(head) + len(meta_b) + len(payload)
-        fh = self._file_for(rec_len)
-        # the record is written in two pieces so the mid-append
-        # crashpoint sits at the worst tear: header+meta on disk, payload
-        # missing — replay must detect the torn record and stop at it
-        fh.write(head + meta_b)
-        if faults.is_armed("wal.append.mid"):
-            fh.flush()  # the partial record must be kernel-visible for
-            # the in-process (raise) crash action to leave the same
-            # on-disk state a SIGKILL after a real flush would
-        faults.crashpoint("wal.append.mid")
-        fh.write(payload)
-        fh.flush()
-        faults.crashpoint("wal.append.pre_fsync")
-        t1 = time.perf_counter()
-        # the critical-path ledger wants append and fsync as DISJOINT
-        # intervals (the recorder's wal_append stage keeps including the
-        # fsync): a no-op unless a traced MP payload is being flushed on
-        # this thread
-        critpath.stamp_active(
-            critpath.SEG_WAL_APPEND, int(t0 * 1e9), int(t1 * 1e9)
-        )
-        if self.fsync:
-            os.fsync(fh.fileno())
-            t2 = time.perf_counter()
-            obs.record("wal_fsync", t2 - t1)
+        try:
+            faults.resource_point("wal.append")
+            fh = self._file_for(rec_len)
+            # the record is written in two pieces so the mid-append
+            # crashpoint sits at the worst tear: header+meta on disk,
+            # payload missing — replay must detect the torn record and
+            # stop at it
+            fh.write(head + meta_b)
+            if faults.is_armed("wal.append.mid"):
+                fh.flush()  # the partial record must be kernel-visible
+                # for the in-process (raise) crash action to leave the
+                # same on-disk state a SIGKILL after a real flush would
+            faults.crashpoint("wal.append.mid")
+            fh.write(payload)
+            fh.flush()
+            faults.crashpoint("wal.append.pre_fsync")
+            t1 = time.perf_counter()
+            # the critical-path ledger wants append and fsync as
+            # DISJOINT intervals (the recorder's wal_append stage keeps
+            # including the fsync): a no-op unless a traced MP payload
+            # is being flushed on this thread
             critpath.stamp_active(
-                critpath.SEG_WAL_FSYNC, int(t1 * 1e9), int(t2 * 1e9)
+                critpath.SEG_WAL_APPEND, int(t0 * 1e9), int(t1 * 1e9)
             )
+            if self.fsync:
+                os.fsync(fh.fileno())
+                t2 = time.perf_counter()
+                obs.record("wal_fsync", t2 - t1)
+                critpath.stamp_active(
+                    critpath.SEG_WAL_FSYNC, int(t1 * 1e9), int(t2 * 1e9)
+                )
+        except OSError as e:
+            if e.errno != errno.ENOSPC:
+                raise
+            self._note_enospc()
+            return self._seq
         # bit-rot injection site (ISSUE 7): the record's payload bytes
         # are durable — damage them at rest; the process keeps running
         faults.corrupt_point(
@@ -133,6 +151,40 @@ class WriteAheadLog:
         self._fh_bytes += rec_len
         obs.record("wal_append", time.perf_counter() - t0)
         return self._seq
+
+    def _note_enospc(self) -> None:
+        """Disk full mid-append: the record is lost (it gets a seq but
+        no durable bytes) and the segment may carry a torn tail. Rotate
+        so post-recovery appends land in a FRESH segment — replay skips
+        a torn segment's tail, so stacking good records behind the tear
+        would silently lose them. The log keeps accepting appends (each
+        retries the disk) and flags itself at-risk until a snapshot
+        re-covers the missed window."""
+        self.enospc_count += 1
+        self.missed_records += 1
+        if not self.at_risk:
+            logger.error(
+                "WAL append hit ENOSPC at seq %d: durability AT RISK "
+                "(acked spans not crash-safe until the next snapshot "
+                "commit)", self._seq,
+            )
+        self.at_risk = True
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def clear_at_risk(self) -> None:
+        """Called after a committed snapshot: full state is durable
+        again, the ENOSPC-missed WAL window no longer matters."""
+        if self.at_risk:
+            logger.info(
+                "WAL at-risk cleared: snapshot re-covered the missed "
+                "window (%d records lost to ENOSPC)", self.missed_records,
+            )
+        self.at_risk = False
 
     def _file_for(self, rec_len: int):
         if self._fh is not None and (
