@@ -48,7 +48,7 @@ def test_lint_package_lints_itself_clean():
 def test_full_rule_catalog_registered():
     assert sorted(all_checkers()) == [
         "ZT00", "ZT01", "ZT02", "ZT03", "ZT04", "ZT05", "ZT06", "ZT07",
-        "ZT08", "ZT09",
+        "ZT08", "ZT09", "ZT10",
     ]
 
 

@@ -202,6 +202,7 @@ class TestTaxonomy:
             "query_fresh", "query_cached", "readpack_transfer", "mp_record",
             "mp_shm_copy", "mp_vocab_replay", "mp_lut_remap",
             "mp_device_feed", "accuracy_rollup", "wire_to_durable",
-            "query_lock_wait", "query_wall",
+            "query_lock_wait", "query_wall", "query_mirror",
+            "mirror_publish",
         }
         assert set(STAGES) == expected

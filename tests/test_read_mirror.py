@@ -1,0 +1,466 @@
+"""Epoch-published read mirror (tpu/mirror.py, ISSUE 14).
+
+The mirror's whole claim is "lock-free AND correct": a single publisher
+cuts immutable epochs under one aggregator-lock hold, readers serve via
+the recorder's fuzz-tested seqlock idiom. These tests pin the claim
+from four sides — the seqlock never serves a torn generation under
+threaded publish/read pressure, a mirror serve at the publish instant
+is byte-identical to the fresh locked read, staleness ages move the
+right way across publishes, and the crash-resume boot publish makes the
+FIRST post-boot serve lock-free and correct. The brownout interplay
+(B1 cache-first loosens the bound, B3 cache-only drops it) and the
+query_mirror_staleness SLO trip/clear round out the operational
+surface.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from tests.fixtures import lots_of_spans
+from tests.test_wal import make
+from zipkin_tpu.obs.recorder import StageRecorder
+from zipkin_tpu.obs.slo import SloSpec, SloWatchdog
+from zipkin_tpu.obs.windows import WindowedTelemetry
+from zipkin_tpu.tpu.mirror import ReadMirror
+
+
+class _FakeAgg:
+    """Version-stamped value source: every registered compute derives
+    from ``value``, so a torn epoch is detectable as a mismatch."""
+
+    def __init__(self):
+        self.write_version = 0
+        self.value = 0
+
+
+def _mirror(agg, **kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("max_stale_ms", 5000.0)
+    return ReadMirror(lambda: agg, **kw)
+
+
+class _FakeCtl:
+    def __init__(self, mode="normal", max_stale_ms=60_000):
+        self.mode = mode
+        self.max_stale_ms = max_stale_ms
+
+    def read_mode(self):
+        return self.mode
+
+
+def _ingest(store, n=400, seed=7):
+    spans = lots_of_spans(n, seed=seed, services=8, span_names=12)
+    store.span_consumer().accept(spans).execute()
+
+
+# -- seqlock publication protocol ----------------------------------------
+
+
+def test_seqlock_fuzz_never_serves_a_torn_generation():
+    """Publisher hammering epochs, 4 readers hammering snapshot(): every
+    observed snapshot must be internally consistent (all values cut from
+    the same agg state) and carry an even generation — the recorder's
+    torn-read guarantee at mirror scale."""
+    agg = _FakeAgg()
+    m = _mirror(agg)
+    m.register("a", lambda: agg.value, pinned=True)
+    m.register("b", lambda: agg.value, pinned=True)
+    m.publish(force=True)
+    stop = threading.Event()
+    violations = []
+
+    def publisher():
+        while not stop.is_set():
+            agg.value += 1
+            agg.write_version += 1
+            m.publish(force=True)
+
+    def reader():
+        for _ in range(4000):
+            snap = m.snapshot()
+            if snap is None:
+                violations.append("no snapshot")
+                continue
+            if snap.generation & 1:
+                violations.append(f"odd generation {snap.generation}")
+            if snap.values["a"] != snap.values["b"]:
+                violations.append(
+                    f"torn epoch: {snap.values['a']} != {snap.values['b']}"
+                )
+
+    threads = [threading.Thread(target=publisher)] + [
+        threading.Thread(target=reader) for _ in range(4)
+    ]
+    for t in threads[1:]:
+        t.start()
+    threads[0].start()
+    for t in threads[1:]:
+        t.join()
+    stop.set()
+    threads[0].join()
+    assert violations == []
+    assert m.publishes > 0
+
+
+def test_serve_counts_and_age_gauges():
+    agg = _FakeAgg()
+    m = _mirror(agg)
+    m.register("k", lambda: agg.value, pinned=True)
+    assert m.serve("k", 5000.0, agg.write_version) is None  # no epoch yet
+    assert m.misses == 1
+    m.publish(force=True)
+    value, age = m.serve("k", 5000.0, agg.write_version)
+    assert value == 0 and age == 0.0  # version matches: FRESH
+    assert (m.serves, m.stale_serves) == (1, 0)
+    agg.write_version += 1  # mutation since publish: stale but in bound
+    value, age = m.serve("k", 5000.0, agg.write_version)
+    assert value == 0 and age >= 0.0
+    assert (m.serves, m.stale_serves) == (2, 1)
+    c = m.counters()
+    assert c["mirrorServes"] == 2 and c["mirrorStaleServes"] == 1
+    assert c["mirrorServeAgeMaxMs"] >= c["mirrorServeAgeMs"] >= 0.0
+
+
+def test_stale_beyond_bound_misses_and_bound_none_serves_any_age():
+    agg = _FakeAgg()
+    m = _mirror(agg)
+    m.register("k", lambda: agg.value, pinned=True)
+    m.publish(force=True)
+    agg.write_version += 1
+    m._snap.published_at -= 10.0  # rewind the epoch 10 s
+    assert m.serve("k", 5000.0, agg.write_version) is None  # > bound
+    hit = m.serve("k", None, agg.write_version)  # B3 cache-only posture
+    assert hit is not None and hit[1] >= 10_000.0
+
+
+def test_staleness_monotonic_between_publishes_and_resets_on_publish():
+    agg = _FakeAgg()
+    m = _mirror(agg)
+    m.register("k", lambda: agg.value, pinned=True)
+    m.publish(force=True)
+    agg.write_version += 1
+    ages = []
+    for _ in range(5):
+        time.sleep(0.002)
+        ages.append(m.serve("k", None, agg.write_version)[1])
+    assert ages == sorted(ages) and ages[0] > 0.0
+    # a new epoch at the current version resets the serve to FRESH
+    m.publish(force=True)
+    assert m.serve("k", None, agg.write_version)[1] == 0.0
+
+
+def test_publish_skips_idle_epochs_but_honors_new_demand():
+    agg = _FakeAgg()
+    m = _mirror(agg)
+    m.register("k", lambda: agg.value, pinned=True)
+    assert m.publish() is True
+    # nothing changed: no device pull, no republish
+    assert m.publish() is False and m.publish_skips == 1
+    # a write makes the next tick publish again
+    agg.write_version += 1
+    assert m.publish() is True
+    # new demand alone (no writes) also forces an epoch — the key's
+    # first serve should not wait out a whole idle period
+    m.register("k2", lambda: agg.value)
+    assert m.publish() is True
+    assert "k2" in m.snapshot().values
+
+
+def test_paced_publish_caps_the_lock_duty_cycle():
+    """The ticker's paced publishes refuse a new epoch until a full
+    last-publish-duration has elapsed since the previous one finished:
+    on a host where the read programs run in seconds, back-to-back
+    multi-second lock holds would convoy every fresh read behind the
+    publisher. Unpaced calls (boot, tests, benchmarks) never back off."""
+    agg = _FakeAgg()
+    m = _mirror(agg)
+    m.register("k", lambda: agg.value, pinned=True)
+    assert m.publish(paced=True) is True  # first epoch: nothing to pace by
+    agg.write_version += 1
+    # pretend the epoch above held the lock for a very long time
+    m.last_publish_ms = 3_600_000.0
+    assert m.publish(paced=True) is False
+    assert m.publish_backoffs == 1 and m.publish_skips == 0
+    # the backoff is the ticker's problem, not the caller's: an
+    # explicit publish (and force) still cuts the epoch immediately
+    assert m.publish() is True
+    agg.write_version += 1
+    m.last_publish_ms = 3_600_000.0
+    assert m.publish(force=True, paced=True) is True
+    # backoff must not eat the demand dirty-bit: a key registered
+    # during the backoff window still rides the next allowed epoch
+    agg.write_version += 1
+    m.last_publish_ms = 3_600_000.0
+    m.register("late", lambda: agg.value)
+    assert m.publish(paced=True) is False
+    m.last_publish_ms = 0.001
+    assert m.publish(paced=True) is True
+    assert "late" in m.snapshot().values
+
+
+def test_demand_registry_expiry_and_bound():
+    agg = _FakeAgg()
+    m = _mirror(agg, max_keys=4)
+    m.register("pin", lambda: 1, pinned=True)
+    m.register("cold", lambda: 2)
+    for _ in range(m.DEMAND_TTL_PUBLISHES + 2):
+        agg.write_version += 1
+        m.publish()
+    # the never-served unpinned key expired; the pinned one survives
+    assert "cold" not in m._demand and "pin" in m._demand
+    m.register("a", lambda: 1)
+    m.register("b", lambda: 1)
+    m.register("c", lambda: 1)
+    assert m.register("overflow", lambda: 1) is False
+    assert m.demand_overflow == 1
+
+
+# -- store integration: parity, escape hatch, brownout -------------------
+
+
+def test_mirror_vs_fresh_byte_parity_at_publish_instant(tmp_path):
+    """At the publish instant (no writes since the epoch) the mirror
+    serve and the fresh locked read are the same bytes: the publisher
+    runs the SAME read programs at _cached_read key granularity."""
+    store = make(tmp_path, wal=False, checkpoint=False)
+    try:
+        _ingest(store)
+        assert store.publish_mirror(force=True)
+        for mirror_read, fresh_read in (
+            (lambda: store.latency_quantiles([0.5, 0.9, 0.99]),
+             lambda: store.latency_quantiles([0.5, 0.9, 0.99],
+                                             staleness_ms=0)),
+            (lambda: store.trace_cardinalities(),
+             lambda: store.trace_cardinalities(staleness_ms=0)),
+        ):
+            served = store.mirror.serves
+            mirrored = mirror_read()
+            assert store.mirror.serves == served + 1, \
+                "read did not come from the mirror"
+            assert json.dumps(mirrored, sort_keys=True) == \
+                json.dumps(fresh_read(), sort_keys=True)
+        # overview: percentile + cardinality payloads identical; the
+        # counters sub-dict carries live serve tallies by design
+        over_m = store.sketch_overview([0.5, 0.9, 0.99])
+        over_f = store.sketch_overview([0.5, 0.9, 0.99], staleness_ms=0)
+        assert over_m["percentiles"] == over_f["percentiles"]
+        assert over_m["cardinalities"] == over_f["cardinalities"]
+    finally:
+        store.close()
+
+
+def test_dependencies_mirror_parity_and_demand_registration(tmp_path):
+    store = make(tmp_path, wal=False, checkpoint=False)
+    try:
+        _ingest(store)
+        end_ts = int(time.time() * 1000) + 86_400_000
+        lookback = 7 * 86_400_000
+        # first default read misses (window key unknown), registers the
+        # demand, and falls through to the locked fresh path
+        fresh = store.get_dependencies(end_ts, lookback).execute()
+        # the miss registered the window's key; the next epoch carries it
+        assert store.publish_mirror(force=True)
+        served = store.mirror.serves
+        mirrored = store.get_dependencies(end_ts, lookback).execute()
+        assert store.mirror.serves == served + 1
+        assert sorted(
+            (x.parent, x.child, x.call_count) for x in mirrored
+        ) == sorted((x.parent, x.child, x.call_count) for x in fresh)
+    finally:
+        store.close()
+
+
+def test_staleness_zero_is_the_lock_path_escape_hatch(tmp_path):
+    store = make(tmp_path, wal=False, checkpoint=False)
+    try:
+        _ingest(store)
+        store.publish_mirror(force=True)
+        serves = store.mirror.serves
+        store.trace_cardinalities(staleness_ms=0)
+        assert store.mirror.serves == serves  # never touched the mirror
+        # and disabling wholesale reverts every read to the lock path
+        store.mirror.enabled = False
+        store.trace_cardinalities()
+        assert store.mirror.serves == serves
+    finally:
+        store.close()
+
+
+def test_brownout_cache_first_and_cache_only_carry_mirror_age(tmp_path):
+    """B1/B2 cache-first loosens the bound to the controller's
+    max_stale_ms; B3 cache-only serves ANY age. Both serve the mirror
+    and the staleness gauges carry the served age."""
+    store = make(tmp_path, wal=False, checkpoint=False)
+    try:
+        _ingest(store)
+        store.publish_mirror(force=True)
+        store.agg.write_version += 1          # epoch now version-stale
+        store.mirror._snap.published_at -= 10.0   # ...and 10 s old
+        # normal mode: 10 s > the 5 s default bound — fresh compute
+        serves = store.mirror.serves
+        store.trace_cardinalities()
+        assert store.mirror.serves == serves
+        # B1 cache-first: the controller's 60 s bound loosens the serve
+        store.overload = _FakeCtl("cache_first", max_stale_ms=60_000)
+        store.trace_cardinalities()
+        assert store.mirror.serves == serves + 1
+        assert store.ingest_counters()["mirrorServeAgeMs"] >= 10_000.0
+        # B3 cache-only: any age serves, even past every bound
+        store.mirror._snap.published_at -= 100.0
+        store.overload = _FakeCtl("cache_only", max_stale_ms=0)
+        store.trace_cardinalities()
+        assert store.mirror.serves == serves + 2
+        assert store.ingest_counters()["mirrorServeAgeMs"] >= 100_000.0
+        assert store.ingest_counters()["mirrorStaleServes"] >= 2
+    finally:
+        store.close()
+
+
+def test_default_reads_stay_exact_on_a_quiet_lock(tmp_path):
+    """THE regression that motivated serve arbitration: a bare store
+    (no ticker republishing) boot-publishes an epoch, then ingests. A
+    default read moments later must NOT serve the now version-stale
+    epoch — the lock is quiet, an exact read is cheap, and callers
+    that never opted into staleness (every pre-mirror test and library
+    user) would otherwise silently read frozen boot-time data for the
+    whole 5 s bound."""
+    store = make(tmp_path, wal=False, checkpoint=False)
+    try:
+        store.publish_mirror(force=True)   # boot epoch: empty state
+        _ingest(store)
+        assert store.trace_cardinalities()["_global"] > 0.0, \
+            "default read served the stale boot epoch"
+        # republish: version-fresh again, so the default read serves
+        # the mirror — exactness and lock-freedom are not in tension
+        store.publish_mirror(force=True)
+        serves = store.mirror.serves
+        assert store.trace_cardinalities()["_global"] > 0.0
+        assert store.mirror.serves == serves + 1
+    finally:
+        store.close()
+
+
+def test_contended_lock_serves_the_stale_epoch_lock_free(tmp_path):
+    """Under actual contention the arbitration flips: while another
+    thread holds the aggregator lock, a default request serves the
+    version-stale epoch within bound instead of queueing — the
+    load posture the mirror exists for, with no opt-in needed."""
+    store = make(tmp_path, wal=False, checkpoint=False)
+    try:
+        _ingest(store)
+        store.publish_mirror(force=True)
+        store.agg.write_version += 1       # epoch now version-stale
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with store.agg.lock:
+                held.set()
+                release.wait(10.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert held.wait(10.0)
+        try:
+            serves = store.mirror.serves
+            stale = store.mirror.stale_serves
+            store.trace_cardinalities()    # default request, lock busy
+            assert store.mirror.serves == serves + 1
+            assert store.mirror.stale_serves == stale + 1
+        finally:
+            release.set()
+            t.join()
+    finally:
+        store.close()
+
+
+def test_clear_resets_the_published_epoch(tmp_path):
+    store = make(tmp_path, wal=False, checkpoint=False)
+    try:
+        _ingest(store)
+        store.publish_mirror(force=True)
+        assert store.mirror.snapshot() is not None
+        store.clear()
+        # the old epoch was cut from a discarded aggregator: gone
+        assert store.mirror.snapshot() is None
+        # pinned demand survives; the next publish refills from the
+        # fresh aggregator
+        assert store.publish_mirror(force=True)
+        assert store.trace_cardinalities().get("_global", 0.0) == 0.0
+    finally:
+        store.close()
+
+
+# -- crash-resume: the boot publish ---------------------------------------
+
+
+def test_crash_resume_rebuilds_mirror_before_first_serve(tmp_path):
+    """The resume adapter publishes the first epoch from the restored
+    state BEFORE the ticker exists: the first post-boot read serves
+    lock-free and matches the pre-crash fresh answer."""
+    store = make(tmp_path)  # wal + checkpoint
+    _ingest(store)
+    store.snapshot()
+    expected = store.trace_cardinalities(staleness_ms=0)
+    store.close()
+
+    revived = make(tmp_path)
+    try:
+        assert revived.mirror.publishes >= 1  # boot publish happened
+        serves = revived.mirror.serves
+        got = revived.trace_cardinalities()
+        assert revived.mirror.serves == serves + 1, \
+            "first post-boot read did not serve from the mirror"
+        assert json.dumps(got, sort_keys=True) == \
+            json.dumps(expected, sort_keys=True)
+    finally:
+        revived.close()
+
+
+# -- the staleness SLO ----------------------------------------------------
+
+
+def test_query_mirror_staleness_slo_trips_and_clears():
+    """The gauge spec pages when serves run older than the published
+    contract (publisher stopped cutting epochs) and clears exactly when
+    ages return inside the bound."""
+    rec = StageRecorder()
+    vals = {"mirrorServeAgeMs": 0.0}
+    t = [1000.0]
+    win = WindowedTelemetry(
+        rec, lambda: dict(vals),
+        tick_s=1.0, slots=16, coarse_slots=4, coarse_factor=16,
+        clock=lambda: t[0],
+    )
+    dog = SloWatchdog(win, [SloSpec(
+        "query_mirror_staleness", "gauge", short_s=4, long_s=8,
+        gauge="mirrorServeAgeMs", limit=5000.0,
+    )])
+
+    def tick(n=1):
+        for _ in range(n):
+            t[0] += 1.0
+            win.tick(t[0])
+
+    tick(2)
+    assert dog.alerts()["query_mirror_staleness"] is False
+    vals["mirrorServeAgeMs"] = 9000.0  # serves nearly 2x the contract
+    tick(2)
+    assert dog.alerts()["query_mirror_staleness"] is True
+    vals["mirrorServeAgeMs"] = 120.0   # publisher back: ages collapse
+    tick(2)
+    assert dog.alerts()["query_mirror_staleness"] is False
+    assert dog.trips == 1 and dog.clears == 1
+
+
+def test_default_specs_include_mirror_staleness():
+    from zipkin_tpu.obs.slo import default_specs
+
+    spec = next(
+        s for s in default_specs() if s.name == "query_mirror_staleness"
+    )
+    assert spec.kind == "gauge" and spec.gauge == "mirrorServeAgeMs"
+    assert spec.limit == 5000.0

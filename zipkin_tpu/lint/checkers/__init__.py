@@ -27,6 +27,10 @@ Rule catalog (grounded in real past regressions — see ARCHITECTURE.md
   inside functions marked ``# zt-dispatch-critical`` — the ingest
   fan-out's single dispatch core must do O(chunks)+O(new-vocab) work,
   never O(spans); justified non-per-span loops carry ZT09 pragmas.
+- ZT10 mirror-served lock acquires: aggregator-lock acquisition (bare
+  ``.lock`` holds, or calls into known lock-taking helpers) reachable
+  from functions marked ``# zt-mirror-served`` — the epoch-published
+  read mirror's serve path must never re-queue readers on the lock.
 """
 
 from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
@@ -35,6 +39,7 @@ from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
     donation,
     freshread,
     locks,
+    mirrorread,
     obsstage,
     pragmas,
     recompile,

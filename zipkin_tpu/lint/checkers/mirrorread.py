@@ -1,0 +1,175 @@
+"""ZT10 — mirror-served reads stay off the aggregator lock.
+
+ISSUE 14's tentpole took the query path off the aggregator lock: the
+epoch-published read mirror (``tpu/mirror.py``) serves immutable
+snapshots behind a seqlock generation stamp, and QUERY_SLO_r08's whole
+p99 claim rests on the serve path never blocking. The regression shape
+this rule fences is quiet and plausible-looking: someone "just adds" a
+live-counter touch or a cache probe to the serve path, the call chain
+re-enters ``_cached_read`` or an aggregator read method, and suddenly 8
+reader threads queue on the lock again — correctness unaffected, the
+SLO gone, and no unit test notices.
+
+Functions opt in with a ``# zt-mirror-served: <reason>`` marker on the
+``def`` header (multi-line signatures work, same mechanics as ZT09's
+dispatch-critical marker). From each marked function the rule walks the
+local call graph (ZT07's conservative reachability: bare-name and
+attribute calls both descend into same-module defs) and flags, anywhere
+reachable:
+
+1. taking the aggregator lock itself — ``with X.lock:`` or
+   ``X.lock.acquire(...)`` where the attribute is spelled exactly
+   ``lock``. The repo's naming convention is load-bearing here: the
+   InstrumentedRLock on the aggregator is the ONE lock published as a
+   bare ``.lock`` attribute; private coordination locks are ``_lock``,
+   ``_demand_lock``, ``_snapshot_lock``, ... and stay legal (the
+   mirror's demand registry uses one).
+2. calls into known lock-taking entrypoints (``LOCK_TAKERS``): the
+   store's version-keyed memoizer and the aggregator read methods that
+   acquire internally. These are correct answers on the WRONG path —
+   each one re-serializes the reader behind ingest holds.
+
+A marker without a reason is itself a finding (the ZT00 bar: opt-in
+claims are reviewable statements, not magic words).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from zipkin_tpu.lint.core import Checker, Module, register
+
+_FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+MARKER_RE = re.compile(r"#\s*zt-mirror-served\b(?P<rest>.*)$")
+
+# entrypoints known to acquire the aggregator lock (directly or one hop
+# down): the store's memoizer + the aggregator's locked read surface.
+# Conservative by NAME — a same-named method on another object is still
+# a finding, because on a mirror-served path there should be no object
+# answering these names at all.
+LOCK_TAKERS = frozenset({
+    "_cached_read",
+    "dependency_edges",
+    "dependency_matrices",
+    "quantiles",
+    "cardinalities",
+    "sketch_overview",
+    "merged_digest",
+    "merged_sketches",
+    "window_fully_rolled",
+    "state_clone",
+    "sync_pend_lanes",
+})
+
+
+def _marker(module: Module, fn: ast.AST):
+    """The zt-mirror-served marker on fn's header lines, if any."""
+    end = fn.body[0].lineno if fn.body else fn.lineno + 1
+    for line_no in range(fn.lineno, end):
+        m = MARKER_RE.search(module.line_text(line_no))
+        if m:
+            return line_no, m.group("rest")
+    return None
+
+
+def _callee_name(func: ast.AST):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_bare_lock_attr(node: ast.AST) -> bool:
+    """True for ``<anything>.lock`` — the aggregator-lock spelling."""
+    return isinstance(node, ast.Attribute) and node.attr == "lock"
+
+
+@register
+class MirrorServedLockAcquire(Checker):
+    rule = "ZT10"
+    severity = "error"
+    name = "mirror-served-lock-acquire"
+    doc = (
+        "aggregator-lock acquisition (direct, or via known lock-taking "
+        "helpers) reachable from functions marked zt-mirror-served"
+    )
+    hint = (
+        "a mirror serve must stay lock-free: read the published "
+        "snapshot, or move the locked work into the mirror publisher "
+        "(one lock hold per epoch, not per query)"
+    )
+
+    def check(self, module: Module):
+        defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, _FUNC_KINDS):
+                defs.setdefault(node.name, node)
+        roots = []
+        for fn in defs.values():
+            marked = _marker(module, fn)
+            if marked is None:
+                continue
+            _line, rest = marked
+            if not rest.lstrip().startswith(":") or not rest.lstrip(": ").strip():
+                yield self.found(
+                    module, fn,
+                    "zt-mirror-served marker without a reason — say WHY "
+                    "this function serves lock-free "
+                    "(# zt-mirror-served: <reason>)",
+                )
+            roots.append(fn)
+        if not roots:
+            return
+        # reachability over local defs (ZT07's walk: attribute calls
+        # descend too — over-approximate rather than miss a helper)
+        reached = {}
+        stack = [(d, d.name) for d in roots]
+        while stack:
+            fn, root = stack.pop()
+            if fn.name in reached:
+                continue
+            reached[fn.name] = (fn, root)
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call):
+                    tgt = defs.get(_callee_name(call.func))
+                    if tgt is not None and tgt.name not in reached:
+                        stack.append((tgt, root))
+        for fn, root in reached.values():
+            yield from self._scan_function(module, fn, root)
+
+    def _scan_function(self, module: Module, fn: ast.AST, root: str):
+        via = "" if fn.name == root else f" (via {fn.name}())"
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+                for item in node.items:
+                    if _is_bare_lock_attr(item.context_expr):
+                        yield self.found(
+                            module, node,
+                            f"aggregator lock held inside mirror-served "
+                            f"{root}(){via} — the serve path re-queues "
+                            "readers behind ingest holds",
+                        )
+            elif isinstance(node, ast.Call):
+                name = _callee_name(node.func)
+                if (
+                    name == "acquire"
+                    and isinstance(node.func, ast.Attribute)
+                    and _is_bare_lock_attr(node.func.value)
+                ):
+                    yield self.found(
+                        module, node,
+                        f"aggregator lock acquired inside mirror-served "
+                        f"{root}(){via} — the serve path re-queues "
+                        "readers behind ingest holds",
+                    )
+                elif name in LOCK_TAKERS:
+                    yield self.found(
+                        module, node,
+                        f"lock-taking helper {name}() called from "
+                        f"mirror-served {root}(){via} — this re-enters "
+                        "the aggregator lock per query; serve the "
+                        "published snapshot instead",
+                    )

@@ -3,9 +3,11 @@
 The ingest half of the pipeline is observable wire-to-durable
 (obs/critpath.py); this module is the read-side mirror. ROADMAP item 4
 says the store must serve many concurrent dashboard readers at
-p99 < 50 ms, and the refactor that gets there (an epoch-published read
-mirror that takes reads off the aggregator lock) needs an instrument to
-judge it. Three pieces:
+p99 < 50 ms; the refactor that got there — the epoch-published read
+mirror in ``tpu/mirror.py`` that takes reads off the aggregator lock —
+is judged by this instrument: mirror serves stamp the lock-free
+``mirror_serve`` segment, and a fresh read that still queues on the
+lock shows up as ``lock_wait``. Three pieces:
 
 - A **thread-local :class:`QueryTrace`** armed at the storage read
   entrypoints (``tpu/store.py``) and stamped — without taking any lock
@@ -66,11 +68,13 @@ QSEG_UNPACK = 5             # zero-copy view carve of the packed buffer
 QSEG_LINK_RESOLVE = 6       # id->name vocab resolution into DependencyLinks
 QSEG_SERIALIZE = 7          # row shaping of device output into API objects
 QSEG_OTHER = 8              # derived: unstamped query time (gap sweep)
-N_QSEGS = 9
+QSEG_MIRROR_SERVE = 9       # lock-free serve from the epoch-published mirror
+N_QSEGS = 10
 
 QSEG_NAMES = (
     "lock_wait", "cache_probe", "device_dispatch", "device_wall",
     "readpack_transfer", "unpack", "link_resolve", "serialize", "other",
+    "mirror_serve",
 )
 _QWAIT = frozenset((QSEG_LOCK_WAIT, QSEG_OTHER))
 QSEG_KIND = tuple(
@@ -287,6 +291,21 @@ class InstrumentedRLock:
         self._hold_t0 = 0
         self._tl.depth = 0
         self._inner.release()
+
+    def would_block(self) -> bool:
+        """Non-blocking contention probe: True when ANOTHER thread
+        holds the lock right now (a read here would queue). Touches
+        neither the ledger (``contended`` is its counter) nor the
+        re-entrancy depth — a probe is not an acquisition. The
+        mirror's serve arbitration uses this: a version-stale epoch
+        may serve a default request only while the fresh path would
+        actually block."""
+        if getattr(self._tl, "depth", 0):
+            return False
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
 
     def relabel(self, label: str) -> None:
         """Override the holder attribution for the CURRENT outermost

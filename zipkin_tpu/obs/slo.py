@@ -130,15 +130,28 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
         # Query-plane observatory (obs/querytrace.py, ISSUE 12): the
         # instrumented aggregator lock relays every outermost wait into
         # query_lock_wait — sustained waits past 10 ms mean readers are
-        # serialized behind ingest holds, the contention ROADMAP item
-        # 4's epoch-published mirror must eliminate. query_wall is the
-        # stitched whole-query critical path, so this spec IS the
-        # "p99 < 50 ms under concurrent readers" target measured from
-        # inside the pipeline rather than from a benchmark harness.
+        # queueing on the lock again, i.e. traffic is bypassing the
+        # epoch-published read mirror (tpu/mirror.py) that took the read
+        # path off the lock (per-request staleness_ms=0 floods, or
+        # TPU_READ_MIRROR=false). query_wall is the stitched whole-query
+        # critical path, so this spec IS the "p99 < 50 ms under
+        # concurrent readers" target measured from inside the pipeline
+        # rather than from a benchmark harness.
         SloSpec("query_lock_wait", "latency", objective=0.99,
                 stage="query_lock_wait", threshold_us=10_000, **kw),
         SloSpec("query_p99_concurrent", "latency", objective=0.99,
                 stage="query_wall", threshold_us=50_000, **kw),
+        # Epoch-published read mirror (tpu/mirror.py, ISSUE 14): the
+        # staleness contract is the price of lock-free serving — mirror
+        # answers may lag the live aggregator by up to the publish
+        # cadence. mirrorServeAgeMs is the age-at-serve gauge (worst
+        # serve in flight resets per read); the limit mirrors the
+        # TPU_MIRROR_MAX_STALE_MS default, so a trip means the publisher
+        # stopped cutting epochs (ticker dead, publish erroring) while
+        # reads kept serving ever-older data — page before dashboards
+        # quietly freeze in time.
+        SloSpec("query_mirror_staleness", "gauge",
+                gauge="mirrorServeAgeMs", limit=5000.0, **kw),
     ]
 
 

@@ -45,6 +45,8 @@ STAGES = (
     "wire_to_durable",   # stitched critical path: wire receipt → WAL-durable ack
     "query_lock_wait",   # outermost wait on the aggregator lock (per acquire)
     "query_wall",        # stitched query critical path: request begin → result
+    "query_mirror",      # lock-free serve from the epoch-published read mirror
+    "mirror_publish",    # one mirror publish: lock once, packed reads, swap
 )
 
 NUM_STAGES = len(STAGES)
@@ -77,6 +79,8 @@ DEFAULT_BUDGETS_US = {
     "wire_to_durable": 5_000_000,
     "query_lock_wait": 50_000,
     "query_wall": 150_000,
+    "query_mirror": 10_000,
+    "mirror_publish": 1_000_000,
 }
 
 assert set(DEFAULT_BUDGETS_US) == set(STAGES)

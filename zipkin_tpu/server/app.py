@@ -226,6 +226,18 @@ class ZipkinServer:
             _qt_core.set_query_observatory(self.config.obs_query_enabled)
         if self._querytrace is not None and self._obs_emitter is not None:
             self._querytrace.emitter = self._obs_emitter
+        # epoch-published read mirror (tpu/mirror.py, ISSUE 14): apply
+        # the configured posture to the store's mirror before any ticker
+        # or route can consult it. TPU_READ_MIRROR=false reverts every
+        # query entrypoint to the locked read path; the max-stale knob
+        # is the published staleness contract the query_mirror_staleness
+        # SLO pages against.
+        self._mirror = getattr(_qt_core, "mirror", None)
+        if self._mirror is not None:
+            self._mirror.enabled = bool(self.config.tpu_read_mirror)
+            self._mirror.max_stale_ms = float(
+                self.config.tpu_mirror_max_stale_ms
+            )
         # windowed telemetry plane + SLO watchdog (ISSUE 9): per-tick
         # delta rings over the recorder/counters, burn-rate evaluation
         # on every tick. The ticker thread follows start()/stop();
@@ -301,6 +313,21 @@ class ZipkinServer:
             # from the lock) before burn evaluation reads them.
             if self._querytrace is not None and self.config.obs_query_enabled:
                 self._obs_windows.on_tick(self._querytrace.on_tick)
+            # mirror publisher on the same ticker, after the stitchers
+            # and BEFORE the watchdog: each tick cuts a fresh epoch (one
+            # aggregator-lock hold runs all packed reads) so queries
+            # serve at most one tick stale under continuous ingest, and
+            # burn evaluation reads this tick's mirror gauges. paced:
+            # when a publish costs more than a tick (slow device reads),
+            # the duty-cycle cap leaves at least equal lock time free
+            # between epochs for fresh reads and ingest.
+            if self._mirror is not None and self._mirror.enabled:
+                _mirror_core = getattr(
+                    self.storage, "delegate", self.storage
+                )
+                self._obs_windows.on_tick(
+                    lambda _w: _mirror_core.publish_mirror(paced=True)
+                )
             if self.config.obs_slo_enabled:
                 from zipkin_tpu.obs.slo import SloWatchdog, default_specs
 
@@ -826,6 +853,14 @@ class ZipkinServer:
         )
         return web.json_response(names)
 
+    @staticmethod
+    def _staleness_param(request: web.Request) -> Optional[float]:
+        """Per-request mirror staleness bound (ms). ``staleness_ms<=0``
+        forces the fresh locked read; absent means the server default.
+        Raises ValueError on garbage (callers 400 it)."""
+        raw = request.query.get("staleness_ms")
+        return float(raw) if raw is not None else None
+
     async def get_dependencies(self, request: web.Request) -> web.Response:
         raw_end = request.query.get("endTs")
         if not raw_end:
@@ -833,13 +868,24 @@ class ZipkinServer:
         try:
             end_ts = int(raw_end)
             lookback = int(request.query.get("lookback") or self.config.default_lookback)
+            staleness = self._staleness_param(request)
         except ValueError as e:
             return web.Response(status=400, text=str(e))
         expired = self._deadline_expired(request)
         if expired is not None:
             return expired
+        # per-request staleness bound routes through only when the
+        # backing store HAS a mirror (the in-memory tier's SPI signature
+        # stays byte-compatible with the reference)
+        kwargs = (
+            {"staleness_ms": staleness}
+            if staleness is not None and self._mirror is not None
+            else {}
+        )
         links = await asyncio.to_thread(
-            lambda: self.storage.span_store().get_dependencies(end_ts, lookback).execute()
+            lambda: self.storage.span_store()
+            .get_dependencies(end_ts, lookback, **kwargs)
+            .execute()
         )
         return web.json_response([json_v2.link_to_dict(x) for x in links])
 
@@ -876,6 +922,7 @@ class ZipkinServer:
             lookback = request.query.get("lookback")
             end_ts = int(end_ts) if end_ts is not None else None
             lookback = int(lookback) if lookback is not None else None
+            staleness = self._staleness_param(request)
         except ValueError as e:
             return web.Response(status=400, text=str(e))
         expired = self._deadline_expired(request)
@@ -889,12 +936,17 @@ class ZipkinServer:
             request.query.get("sketch", "digest") == "digest",
             end_ts,
             lookback,
+            staleness,
         )
         return web.json_response(rows)
 
     async def get_tpu_cardinalities(self, request: web.Request) -> web.Response:
+        try:
+            staleness = self._staleness_param(request)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
         return web.json_response(
-            await asyncio.to_thread(self.storage.trace_cardinalities)
+            await asyncio.to_thread(self.storage.trace_cardinalities, staleness)
         )
 
     async def get_tpu_counters(self, request: web.Request) -> web.Response:
@@ -915,6 +967,7 @@ class ZipkinServer:
             qs = [float(x) for x in raw_q.split(",") if x]
             if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
                 raise ValueError(f"q out of range: {raw_q!r}")
+            staleness = self._staleness_param(request)
         except ValueError as e:
             return web.Response(status=400, text=str(e))
         expired = self._deadline_expired(request)
@@ -925,6 +978,7 @@ class ZipkinServer:
             qs,
             request.query.get("serviceName"),
             request.query.get("spanName"),
+            staleness,
         )
         return web.json_response(body)
 
@@ -1062,6 +1116,17 @@ class ZipkinServer:
                 "queryLockHoldP50Us", "queryLockHoldP99Us",
                 "queryLockHoldMaxUs", "readCacheServeAgeMs",
                 "readCacheServeAgeMaxMs", "readCacheEntries",
+            ):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
+            # epoch-published read mirror (ISSUE 14): publish cadence,
+            # serve tallies, and staleness-at-serve — the gauges the
+            # query_mirror_staleness SLO and the r08 bench read
+            for name in (
+                "mirrorGeneration", "mirrorPublishes", "mirrorPublishSkips",
+                "mirrorPublishBackoffs",
+                "mirrorPublishMs", "mirrorServes", "mirrorStaleServes",
+                "mirrorMisses", "mirrorServeAgeMs", "mirrorServeAgeMaxMs",
             ):
                 if name in counters:
                     out[f"gauge.zipkin_tpu.{name}"] = counters[name]
@@ -1281,6 +1346,10 @@ class ZipkinServer:
             body["queries"] = await asyncio.to_thread(
                 self._querytrace.waterfall
             )
+        # epoch-published read mirror (ISSUE 14): current snapshot epoch
+        # (generation, write version, age) + publish/serve ledger
+        if self._mirror is not None:
+            body["mirror"] = await asyncio.to_thread(self._mirror.status)
         # overload control plane (ISSUE 13): ladder state, the live
         # signal fold, admission posture, and the transition history
         if self._overload is not None:

@@ -159,6 +159,18 @@ class ServerConfig:
     overload_dwell_ticks: int = 5
     overload_max_stale_ms: int = 5000
     overload_retry_base_s: float = 0.25
+    # epoch-published read mirror (tpu/mirror.py, ISSUE 14): the windows
+    # ticker republishes the packed read-program outputs once per tick
+    # (one aggregator-lock hold per epoch) and the query entrypoints
+    # serve lock-free from the published snapshot by default.
+    # TPU_READ_MIRROR=false reverts every read to the lock path;
+    # TPU_MIRROR_MAX_STALE_MS is the published staleness contract — the
+    # oldest answer the mirror may serve without a per-request override
+    # (the staleness_ms query param loosens/tightens per request; <= 0
+    # forces a fresh read), and the bound the query_mirror_staleness
+    # SLO pages on.
+    tpu_read_mirror: bool = True
+    tpu_mirror_max_stale_ms: int = 5000
     # deadline propagation (ISSUE 13): honor gRPC deadlines and the
     # X-Request-Timeout-Ms HTTP header at ingest + query entrypoints —
     # work already past its deadline is dropped before device dispatch
@@ -302,6 +314,10 @@ class ServerConfig:
             overload_max_stale_ms=_env_int("TPU_OVERLOAD_MAX_STALE_MS", 5000),
             overload_retry_base_s=_env_float(
                 "TPU_OVERLOAD_RETRY_BASE_S", 0.25
+            ),
+            tpu_read_mirror=_env_bool("TPU_READ_MIRROR", True),
+            tpu_mirror_max_stale_ms=_env_int(
+                "TPU_MIRROR_MAX_STALE_MS", 5000
             ),
             deadline_propagation_enabled=_env_bool("TPU_DEADLINES", True),
             tpu_resume_dir=resume_dir,

@@ -144,9 +144,14 @@ class ThrottledStorage(StorageComponent):
                 return outer._wrap(inner.get_traces_query(request))
 
             def get_dependencies(
-                self, end_ts: int, lookback: int
+                self, end_ts: int, lookback: int, **kwargs
             ) -> Call[List[DependencyLink]]:
-                return outer._wrap(inner.get_dependencies(end_ts, lookback))
+                # kwargs carries non-SPI extensions (the TPU tier's
+                # per-request staleness_ms mirror bound); the server only
+                # passes them when the delegate supports the mirror
+                return outer._wrap(
+                    inner.get_dependencies(end_ts, lookback, **kwargs)
+                )
 
         return _Store()
 

@@ -152,9 +152,15 @@ class TpuStorage(_CoreTpuStorage):
         # from the durable span count — the last leg of the boot-time
         # restore sequence (snapshot -> WAL replay -> transport offset)
         self.resume_offset = int(self.agg.host_counters.get("spans", 0))
+        # cut the first mirror epoch from the restored state BEFORE the
+        # ticker exists: the first post-boot dashboard read serves
+        # lock-free from a snapshot that already reflects the resumed
+        # sketches (crash-resume contract, tests/test_read_mirror.py)
+        self.publish_mirror()
         # the transfer ledger measures SERVING traffic (one pull per
         # query is the invariant); boot-time restore/replay pulls are
-        # not queries, so the count starts clean here
+        # not queries, so the count starts clean here — the boot mirror
+        # publish above happens first for the same reason
         self.agg.read_stats["host_transfers"] = 0
         # background at-rest CRC scrubber (ISSUE 7): re-verifies sealed
         # WAL segments, archive frames, and retained snapshot

@@ -45,11 +45,21 @@ from zipkin_tpu.storage.spi import (
     StorageComponent,
 )
 from zipkin_tpu.tpu.columnar import SpanColumns, Vocab, pack_spans
+from zipkin_tpu.tpu.mirror import ReadMirror
 from zipkin_tpu.tpu.state import AggConfig
 from zipkin_tpu.utils.call import Call
 from zipkin_tpu.utils.component import CheckResult, Component
 
 logger = logging.getLogger(__name__)
+
+# the dashboard's default quantile list (server endpoints default
+# ``q=0.5,0.9,0.99``): the mirror seeds these reads at construction so
+# the first post-boot dashboard refresh is already lock-free
+DEFAULT_QS = (0.5, 0.9, 0.99)
+
+# sentinel returned by _mirror_bound when the request opted out of the
+# mirror (staleness_ms <= 0): force the fresh lock-path read
+_MIRROR_FRESH = object()
 
 
 from zipkin_tpu.native import PARSED_FIELDS as _PARSED_FIELDS
@@ -292,6 +302,14 @@ class TpuStorage(
             lambda: getattr(self.agg, "lock", None)
         )
         self._query_obs_enabled: Optional[bool] = None
+        # epoch-published read mirror (tpu/mirror.py, ISSUE 14): the
+        # publisher — windows ticker in production, boot publish in the
+        # resume adapter — takes the aggregator lock ONCE per epoch and
+        # republishes every demanded read; queries then serve lock-free
+        # with a stamped staleness age. The provider resolves self.agg
+        # lazily for the same reason the querytrace lock provider does.
+        self.mirror = ReadMirror(lambda: getattr(self, "agg", None))
+        self._seed_mirror()
         # archive-only restart: segment columns store vocab IDS, so the
         # ids must survive the process or every recovered segment becomes
         # unsearchable. A snapshot restore (storage/tpu.py) replaces the
@@ -1089,21 +1107,151 @@ class TpuStorage(
             self._read_cache.clear()
             self._deps_cache.clear()
 
-    def get_dependencies(self, end_ts: int, lookback: int) -> Call[List[DependencyLink]]:
+    # -- epoch-published read mirror (tpu/mirror.py, ISSUE 14) -----------
+
+    def _seed_mirror(self) -> None:
+        """Pin the dashboard's default reads into the mirror's demand
+        registry so the FIRST publish (boot, before the ticker starts)
+        already carries them — the first post-boot dashboard refresh is
+        lock-free, not a warming miss. Keys match `_cached_read`'s so
+        mirror and fresh paths memoize the same computes."""
+        qs = DEFAULT_QS
+        qkey = ",".join(f"{q:.6g}" for q in qs)
+        self.mirror.register(
+            f"overview:{qkey}",
+            lambda: self.agg.sketch_overview(qs), pinned=True,
+        )
+        self.mirror.register(
+            "card", lambda: self.agg.cardinalities(), pinned=True,
+        )
+        self.mirror.register(
+            f"quant:digest:{qkey}",
+            lambda: self.agg.quantiles(qs, source="digest"), pinned=True,
+        )
+
+    def publish_mirror(self, force: bool = False,
+                       paced: bool = False) -> bool:
+        """One mirror epoch (see ReadMirror.publish): the windows ticker
+        calls this each tick (``paced=True`` — the duty-cycle cap); the
+        resume adapter calls it at boot."""
+        return self.mirror.publish(force=force, paced=paced)
+
+    def _mirror_bound(
+        self, staleness_ms: Optional[float], default_ms: float
+    ):
+        """Fold the per-request staleness bound with the brownout read
+        mode into ONE effective bound: ms the serve may be stale, None
+        for any age (B3 cache-only), or _MIRROR_FRESH when the request
+        opted out (``staleness_ms <= 0`` — the escape hatch for
+        staleness-intolerant queries). Under B1/B2 cache-first the
+        controller's bound can only LOOSEN the request's — brownout
+        never makes answers fresher, it keeps them cheap."""
+        if staleness_ms is not None and staleness_ms <= 0:
+            return _MIRROR_FRESH
+        bound = (
+            float(staleness_ms) if staleness_ms is not None
+            else float(default_ms)
+        )
+        ctl = self.overload
+        mode = ctl.read_mode() if ctl is not None else "normal"
+        if mode == "cache_first":
+            bound = max(bound, float(ctl.max_stale_ms))
+        elif mode == "cache_only":
+            return None
+        return bound
+
+    def _mirror_serve(self, key: str, bound_ms, allow_stale: bool = True):  # zt-mirror-served: the whole point — a mirror serve must never acquire the aggregator lock (ZT10)
+        """Serve ``key`` from the published mirror epoch, entirely
+        lock-free: seqlock snapshot read, staleness check against the
+        live write_version, stamp + record. None on a miss (caller
+        falls through to the lock path and registers demand)."""
+        mirror = self.mirror
+        if mirror is None or not mirror.enabled:
+            return None
+        t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
+        hit = mirror.serve(
+            key, bound_ms, self.agg.write_version, allow_stale
+        )
+        if hit is None:
+            return None
+        obs.record("query_mirror", time.perf_counter() - t0)
+        querytrace.stamp_active(
+            querytrace.QSEG_MIRROR_SERVE, t0_ns, time.perf_counter_ns()
+        )
+        return hit
+
+    def _mirror_allow_stale(self, staleness_ms) -> bool:
+        """May THIS request see a version-stale epoch? Yes when the
+        caller opted in (explicit positive ``staleness_ms``), a
+        brownout read mode is in force, or the aggregator lock is
+        contended right now (non-blocking probe) — otherwise an exact
+        read is cheap and default requests stay exact, the same
+        posture ``_cached_read`` takes outside brownout. The probe is
+        deliberately last: single-threaded callers never pay it a
+        surprise stale answer, and under the load the mirror exists
+        for, it is what keeps readers off the lock."""
+        if staleness_ms is not None:
+            return True
+        ctl = self.overload
+        if ctl is not None and ctl.read_mode() != "normal":
+            return True
+        probe = getattr(self.agg.lock, "would_block", None)
+        return bool(probe is not None and probe())
+
+    def _mirror_read(self, key: str, compute, staleness_ms=None):
+        """Mirror-first read: serve lock-free from the published epoch
+        when the age allows; otherwise register the key for the next
+        epoch and fall through to the versioned read cache (which is
+        where the aggregator lock — and the brownout cache-first logic
+        for version-stale entries — lives). A cold key still computes
+        fresh, so a brownout never turns into an outage for
+        first-touch queries."""
+        bound = self._mirror_bound(staleness_ms, self.mirror.max_stale_ms)
+        if bound is not _MIRROR_FRESH:
+            hit = self._mirror_serve(
+                key, bound, self._mirror_allow_stale(staleness_ms)
+            )
+            if hit is not None:
+                return hit[0]
+            self.mirror.register(key, compute)
+        return self._cached_read(key, compute)
+
+    def get_dependencies(
+        self, end_ts: int, lookback: int,
+        staleness_ms: Optional[float] = None,
+    ) -> Call[List[DependencyLink]]:
         def run() -> List[DependencyLink]:
             qt = self.querytrace.begin("dependencies")
             try:
-                return self._get_dependencies(end_ts, lookback)
+                return self._get_dependencies(end_ts, lookback, staleness_ms)
             finally:
                 self.querytrace.finish(qt)
 
         return Call.of(run)
 
     def _get_dependencies(
-        self, end_ts: int, lookback: int
+        self, end_ts: int, lookback: int,
+        staleness_ms: Optional[float] = None,
     ) -> List[DependencyLink]:
             lo_min = epoch_minutes(end_ts - lookback)
             hi_min = epoch_minutes(end_ts)
+            # mirror-first: the published epoch carries the final link
+            # list (resolved on the publisher thread), so a hit returns
+            # without touching the aggregator lock OR the deps cache.
+            # Dependencies already tolerate bounded staleness by design
+            # (the reference's table is an offline batch job), so the
+            # deps bound — not the general mirror bound — is the default.
+            bound = self._mirror_bound(staleness_ms, self._deps_max_stale_ms)
+            if bound is not _MIRROR_FRESH:
+                mkey = f"deps:{lo_min}:{hi_min}"
+                hit = self._mirror_serve(mkey, bound)
+                if hit is not None:
+                    return hit[0]
+                self.mirror.register(
+                    mkey,
+                    lambda: self._dependency_links(lo_min, hi_min),
+                )
             fresh = self.agg.write_version
             now = time.monotonic()
             t0 = time.perf_counter()
@@ -1144,8 +1292,23 @@ class TpuStorage(
     def _compute_dependencies(
         self, lo_min: int, hi_min: int
     ) -> List[DependencyLink]:
+        return self._dependency_links(
+            lo_min, hi_min, fetch=self._cached_read
+        )
+
+    def _dependency_links(
+        self, lo_min: int, hi_min: int, fetch=None
+    ) -> List[DependencyLink]:
+            # edge pull + vocab resolution, parameterized by the fetch
+            # seam: the query path memoizes through _cached_read; the
+            # mirror publisher (already holding the aggregator lock for
+            # its one epoch hold) calls the aggregator directly so a
+            # publish never populates the versioned read cache
+            if fetch is None:
+                def fetch(_key, compute):
+                    return compute()
             # edges compacted on device: [E] vectors, not dense [S, S]
-            idx, calls, errors = self._cached_read(
+            idx, calls, errors = fetch(
                 f"edges:{lo_min}:{hi_min}",
                 lambda: self.agg.dependency_edges(lo_min, hi_min),
             )
@@ -1162,7 +1325,7 @@ class TpuStorage(
                     len(calls),
                 )
                 lo2, hi2 = lo_min, hi_min
-                dense_c, dense_e = self._cached_read(
+                dense_c, dense_e = fetch(
                     f"depmat:{lo2}:{hi2}",
                     lambda: self.agg.dependency_matrices(lo2, hi2),
                 )
@@ -1200,6 +1363,7 @@ class TpuStorage(
         use_digest: bool = True,
         end_ts: Optional[int] = None,
         lookback: Optional[int] = None,
+        staleness_ms: Optional[float] = None,
     ) -> List[dict]:
         """Latency percentile rows per (service, spanName) — the read the
         Lens duration-percentile context needs, served from sketches.
@@ -1209,6 +1373,10 @@ class TpuStorage(
         covering the most recent T*slice_minutes of traffic (older
         windows return no rows; the all-time path has no window).
         Returns dicts: {service, spanName, count, quantiles: {q: µs}}.
+
+        ``staleness_ms`` tunes the mirror-first serve: None accepts the
+        mirror's published bound, a positive value tightens/loosens it
+        per request, and <= 0 forces a fresh lock-path read.
         """
         qt = self.querytrace.begin("quantiles")
         try:
@@ -1222,17 +1390,19 @@ class TpuStorage(
                 lb = lookback if lookback is not None else end_ts
                 lo_min = epoch_minutes(end_ts - lb)
                 hi_min = epoch_minutes(end_ts)
-                source_q, counts = self._cached_read(
+                source_q, counts = self._mirror_read(
                     f"quant:w:{lo_min}:{hi_min}:{qkey}",
                     lambda: self.agg.quantiles(
                         qs, ts_lo_min=lo_min, ts_hi_min=hi_min
                     ),
+                    staleness_ms,
                 )
             else:
                 src = "digest" if use_digest else "hist"
-                source_q, counts = self._cached_read(
+                source_q, counts = self._mirror_read(
                     f"quant:{src}:{qkey}",
                     lambda: self.agg.quantiles(qs, source=src),
+                    staleness_ms,
                 )
 
             return self._quantile_rows(
@@ -1325,11 +1495,17 @@ class TpuStorage(
                 out[name] = float(est[sid])
         return out
 
-    def trace_cardinalities(self) -> dict:
+    def trace_cardinalities(
+        self, staleness_ms: Optional[float] = None
+    ) -> dict:
         """Estimated distinct trace counts: {"_global": n, service: n, ...}."""
         qt = self.querytrace.begin("cardinalities")
         try:
-            est = self._cached_read("card", self.agg.cardinalities)
+            # lambda, not the bound method: a registered demand closure
+            # must deref self.agg at CALL time (clear() swaps it)
+            est = self._mirror_read(
+                "card", lambda: self.agg.cardinalities(), staleness_ms
+            )
             return self._cardinality_rows(est)
         finally:
             self.querytrace.finish(qt)
@@ -1339,18 +1515,22 @@ class TpuStorage(
         qs: Sequence[float],
         service_name: Optional[str] = None,
         span_name: Optional[str] = None,
+        staleness_ms: Optional[float] = None,
     ) -> dict:
         """Everything the UI sketch page shows, from ONE device dispatch
         and ONE device→host transfer: {"percentiles": latency_quantiles
         rows, "cardinalities": trace_cardinalities dict, "counters":
         ingest_counters dict}. Replaces three aggregator reads (and three
-        HTTP round trips) per page refresh."""
+        HTTP round trips) per page refresh. Mirror-served by default:
+        the raw packed triple comes from the published epoch (row
+        shaping and the live counters dict still run per request)."""
         qt = self.querytrace.begin("overview")
         try:
             qkey = ",".join(f"{q:.6g}" for q in qs)
-            source_q, counts, est = self._cached_read(
+            source_q, counts, est = self._mirror_read(
                 f"overview:{qkey}",
                 lambda: self.agg.sketch_overview(qs),
+                staleness_ms,
             )
             return {
                 "percentiles": self._quantile_rows(
@@ -1443,6 +1623,11 @@ class TpuStorage(
             # brownout cache-first/cache-only serves (ISSUE 13):
             # version-stale answers served under overload read modes
             "readCacheStaleServes": self._read_cache_stale_serves,
+            # epoch-published read mirror (ISSUE 14): generation,
+            # publish cost, lock-free serve tallies, staleness-at-serve
+            # gauges — mirrorServeAgeMs backs the query_mirror_staleness
+            # SLO and the zipkin_tpu_mirror_* prometheus families
+            **self.mirror.counters(),
         }
 
     def set_query_observatory(self, on: bool) -> None:
@@ -1499,6 +1684,10 @@ class TpuStorage(
 
         self._archive.clear()
         self.agg = ShardedAggregator(self.config, mesh=self.agg.mesh)
+        # the swap replaced the aggregator: the published mirror epoch
+        # was cut against versions that no longer compare — drop it
+        # (demand keys survive; the next publish refills)
+        self.mirror.reset()
         # the swap replaced the instrumented lock; drop stitched state
         # from the old aggregator and reapply configured enablement
         self.querytrace.reset()
