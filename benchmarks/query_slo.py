@@ -28,6 +28,18 @@ workload against the raw aggregator lock (the r07 baseline that spent
 mirror, at 8 and 32 threads, with staleness-at-serve percentiles and a
 mirror-vs-fresh byte-parity check at the publish instant.
 
+r09 (ISSUE 15) adds the time-tier section: a dedicated store ingests a
+full day of 5-minute buckets (sealed through the production tt_seal
+protocol, fine ring -> coarse blocks -> disk), then (a) decomposes the
+host-side merge cost per lookback span (5m / 1h / 24h: covering
+segments, coarse-vs-fine split, merge wall), (b) measures the unsealed
+current-bucket read (the one packed device pull a live window pays),
+(c) runs the mixed windowed/cumulative concurrent leg at 8 threads
+through the mirror's demand-registered ``ttq:`` keys — the windowed
+query_wall p99 < 50 ms / lock-wait < 10% gate — and (d) audits the
+windowed shadow-accuracy gauges at full live-bench coverage (the
+NO-ALERT check for the default windowed drift SloSpecs).
+
 Run from the repo root: ``python -m benchmarks.query_slo``.
 """
 
@@ -254,6 +266,298 @@ def _concurrent_leg(store, end_ts_ms: int, qs, n_threads: int,
             "max": round(ages[-1], 3),
         } if ages else None
     return out
+
+
+# -- ISSUE 15: time-disaggregated sketch tier ---------------------------
+
+_TT_G = 5                    # time_bucket_minutes
+_TT_BASE_MIN = 10_000_000    # deterministic anchor, divisible by _TT_G
+_LB_5M, _LB_1H, _LB_24H = 300_000, 3_600_000, 86_400_000
+
+
+def _tt_epoch_spans(ep_offsets, per, seed):
+    """Client chains inside the given bucket epochs (offsets from the
+    anchor) — the windowed workload's span soup, one rng stream so the
+    shadow audit sees exactly what the store ingested."""
+    import random
+
+    from zipkin_tpu.model.span import Endpoint, Kind, Span
+
+    rng = random.Random(seed)
+    svcs = [
+        Endpoint.create(f"svc{i:02d}", f"10.0.1.{i + 1}") for i in range(8)
+    ]
+    spans = []
+    seq = 0
+    for off in ep_offsets:
+        for _ in range(per):
+            seq += 1
+            trace_id = f"{rng.getrandbits(63) | 1:016x}"
+            t_min = _TT_BASE_MIN + off * _TT_G + rng.randrange(_TT_G)
+            parent_id = None
+            caller = rng.randrange(len(svcs))
+            for level in range(rng.randint(1, 3)):
+                span_id = f"{(seq << 8 | level) + 1:016x}"
+                err = {"error": "boom"} if rng.random() < 0.02 else {}
+                spans.append(Span.create(
+                    trace_id=trace_id, id=span_id, parent_id=parent_id,
+                    name=f"op{rng.randrange(12):02d}",
+                    kind=Kind.CLIENT,
+                    local_endpoint=svcs[(caller + level) % len(svcs)],
+                    remote_endpoint=svcs[(caller + level + 1) % len(svcs)],
+                    timestamp=t_min * 60_000_000 + rng.randrange(1000),
+                    duration=int(rng.paretovariate(1.2) * 1000) + 50,
+                    tags=err,
+                ))
+                parent_id = span_id
+    return spans
+
+
+def _tt_concurrent_leg(store, qs, end_ts_ms, n_threads: int) -> dict:
+    """Mixed windowed/cumulative concurrent reads through the mirror.
+
+    Every windowed request canonicalizes to a bucket-aligned
+    ``ttq:<lo_ep>:<hi_ep>`` demand key, so after the warm pass + one
+    publish the whole leg serves off the published WindowAnswers —
+    lock-free regardless of lookback width. The decomposition proves it
+    the same way the r08 leg did: querytrace waterfall segments, with
+    lock_wait share as the gate."""
+    import threading
+
+    from zipkin_tpu import obs
+    from zipkin_tpu.obs.windows import WindowedTelemetry
+
+    iters = int(os.environ.get("QUERY_SLO_CONC_ITERS", 12))
+    store.set_query_observatory(True)
+    store.mirror.enabled = True
+    staleness = store.mirror.max_stale_ms
+
+    def q_5m():
+        store.latency_quantiles(
+            qs, end_ts=end_ts_ms, lookback=_LB_5M, staleness_ms=staleness
+        )
+
+    def q_1h():
+        store.latency_quantiles(
+            qs, end_ts=end_ts_ms, lookback=_LB_1H, staleness_ms=staleness
+        )
+
+    def card_24h():
+        store.trace_cardinalities(
+            end_ts=end_ts_ms, lookback=_LB_24H, staleness_ms=staleness
+        )
+
+    def deps_1h():
+        store.get_dependencies(
+            end_ts_ms, _LB_1H, staleness_ms=staleness
+        ).execute()
+
+    def q_cumulative():
+        store.latency_quantiles(qs, staleness_ms=staleness)
+
+    workload = [q_5m, q_1h, card_24h, deps_1h, q_cumulative]
+    for fn in workload:  # register demand keys (deliberate first-touch)
+        fn()
+    store.publish_mirror(force=True)
+    store.querytrace.reset()
+    obs.RECORDER.reset()
+    windows = WindowedTelemetry(obs.RECORDER, tick_s=1.0)
+    serves0 = store.mirror.serves
+
+    walls_ms = [[] for _ in range(n_threads)]
+    barrier = threading.Barrier(n_threads)
+
+    def reader(k: int) -> None:
+        barrier.wait()
+        for j in range(iters):
+            fn = workload[(k + j) % len(workload)]
+            t1 = time.perf_counter()
+            fn()
+            walls_ms[k].append((time.perf_counter() - t1) * 1e3)
+
+    threads = [
+        threading.Thread(target=reader, args=(k,)) for k in range(n_threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    store.querytrace.stitch()
+    windows.tick()
+    wf = store.querytrace.waterfall()
+    flat = sorted(w for per in walls_ms for w in per)
+    total = len(flat)
+    p99_ms = _percentile(flat, 0.99)
+    segs = {s["name"]: s["sumUs"] for s in wf["segments"]}
+    lock_wait_us = segs.get("lock_wait", 0)
+    mirror_us = segs.get("mirror_serve", 0)
+    attributed = max(1, sum(segs.values()))
+    win_wall = windows.window(3600.0).stage("query_wall")
+    ttq_keys = sorted(
+        k for k in store.mirror._demand if k.startswith("ttq:")
+    )
+    return {
+        "threads": n_threads,
+        "staleness_request_ms": staleness,
+        "queries": total,
+        "queries_per_sec": round(total / elapsed, 1),
+        "wall_ms": _stats(flat),
+        "p99_ms": round(p99_ms, 2),
+        "mirror_serves": store.mirror.serves - serves0,
+        "ttq_demand_keys": ttq_keys,
+        "split_fraction": {
+            "lock_wait": round(lock_wait_us / attributed, 4),
+            "mirror_serve": round(mirror_us / attributed, 4),
+        },
+        "windowed_query_wall_count": win_wall.count,
+        "windowed_query_wall_p99_ms": round(win_wall.p99_us / 1e3, 3),
+        "windowed_count_matches": bool(win_wall.count == total),
+    }
+
+
+def _timetier_section(small: bool, qs) -> dict:
+    """The r09 artifact's time-tier section: seal a day of buckets,
+    decompose merge cost per lookback, gate the concurrent windowed
+    leg, audit the windowed shadow gauges."""
+    from zipkin_tpu.model import json_v2
+    from zipkin_tpu.obs.accuracy import AccuracyEstimator
+    from zipkin_tpu.obs.shadow import HostShadow
+    from zipkin_tpu.storage.tpu import TpuStorage as HostedTpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    epochs = int(os.environ.get("QUERY_SLO_TT_EPOCHS", 288))  # 24 h of 5 m
+    per = 128  # traces per bucket (~2x spans; keeps per-bucket p99 stable)
+    if small:
+        config = AggConfig(
+            max_services=64, max_keys=256, hll_precision=8,
+            digest_centroids=16, digest_buffer=1 << 16,
+            ring_capacity=1 << 16, link_buckets=4, hist_slices=2,
+            time_buckets=4, time_bucket_minutes=_TT_G,
+        )
+    else:
+        config = AggConfig(time_bucket_minutes=_TT_G)
+    arch = tempfile.mkdtemp(prefix="query_slo_tt_")
+    store = HostedTpuStorage(
+        config=config, num_devices=1, batch_size=4096, archive_dir=arch,
+    )
+    try:
+        # -- ingest a day in bucket order, sealing as the ticker would --
+        # blocks of W-1 epochs: the sealer never seals the CURRENT
+        # (still-filling) bucket, so advancing by a full W per seal
+        # would recycle each block's top slot before its seal — W-1
+        # keeps every finished bucket resident until sealed, exactly
+        # the steady-state the production tick cadence guarantees
+        spans_all = []
+        block = max(1, int(config.time_buckets) - 1)
+        t_ing0 = time.perf_counter()
+        for lo in range(0, epochs, block):
+            batch = _tt_epoch_spans(
+                range(lo, min(lo + block, epochs)), per=per, seed=lo + 1
+            )
+            spans_all.extend(batch)
+            store.ingest_json_fast(json_v2.encode_span_list(batch))
+            store.tt_seal()
+        # the live bucket (epoch `epochs`) starts filling; sealing now
+        # finishes the day: sealed_through = epochs-1, current unsealed
+        live_block = _tt_epoch_spans([epochs], per=per, seed=epochs + 1)
+        spans_all.extend(live_block)
+        store.ingest_json_fast(json_v2.encode_span_list(live_block))
+        store.tt_seal()
+        ingest_wall = time.perf_counter() - t_ing0
+        tier = store.timetier
+        sealed_end_ts = (_TT_BASE_MIN + epochs * _TT_G) * 60_000 - 1
+
+        # -- merge-cost decomposition per lookback span -----------------
+        reps = 5
+        merge_cost = {}
+        for label, lb in (("5m", _LB_5M), ("1h", _LB_1H), ("24h", _LB_24H)):
+            lo_ep, hi_ep = store._tt_epochs(sealed_end_ts, lb)
+            parts, covered, missing = tier.cover(lo_ep, hi_ep)  # warms LRU
+            coarse = sum(1 for p in parts if p.hi_ep > p.lo_ep)
+            xs = []
+            for _ in range(reps):
+                t1 = time.perf_counter()
+                tier.window(store.agg, lo_ep, hi_ep)
+                xs.append((time.perf_counter() - t1) * 1e3)
+            merge_cost[label] = {
+                "epochs": hi_ep - lo_ep + 1,
+                "segments_merged": len(parts),
+                "coarse_blocks": coarse,
+                "fine_segments": len(parts) - coarse,
+                "covered": covered,
+                "missing": missing,
+                "merge_wall_ms": _stats(xs),
+            }
+
+        # -- unsealed current bucket: the one packed device pull --------
+        live_end_ts = (_TT_BASE_MIN + (epochs + 1) * _TT_G) * 60_000 - 1
+        lo_ep, hi_ep = store._tt_epochs(live_end_ts, _LB_5M)
+        xs = []
+        for _ in range(reps):
+            t1 = time.perf_counter()
+            ans = tier.window(store.agg, lo_ep, hi_ep)
+            xs.append((time.perf_counter() - t1) * 1e3)
+        merge_cost["5m_unsealed"] = {
+            "epochs": hi_ep - lo_ep + 1,
+            "reaches_device": bool(ans.unsealed),
+            "merge_wall_ms": _stats(xs),
+        }
+
+        # -- windowed shadow-accuracy audit at full coverage ------------
+        shadow = HostShadow(
+            bucket_minutes=_TT_G, link_rate=0.0, seed=11,
+            svc_resolver=store.vocab.services.get,
+        )
+        shadow.offer_spans(spans_all)
+        shadow.drain()
+        acc = AccuracyEstimator(store, shadow, rollup_s=0.0)
+        g = acc.rollup()
+        # limits = the default windowed SloSpecs (obs/slo.py)
+        shadow_report = {
+            "coverage": g["accuracyShadowCoverage"],
+            "windowed_digest_p99_relerr":
+                g["accuracyWindowedDigestP99RelErr"],
+            "windowed_digest_p99_drift": g["accuracyWindowedDigestP99Drift"],
+            "windowed_hll_relerr": g["accuracyWindowedHllRelErr"],
+            "windowed_hll_drift": g["accuracyWindowedHllDrift"],
+            "no_alert": bool(
+                g["accuracyWindowedDigestP99Drift"] < 0.20
+                and g["accuracyWindowedHllDrift"] < 0.15
+            ),
+        }
+
+        # -- the concurrent windowed gate (8 threads, via mirror) -------
+        concurrent = _tt_concurrent_leg(store, qs, sealed_end_ts, 8)
+        slo = {
+            "p99_ms": concurrent["p99_ms"],
+            "p99_under_50ms": bool(concurrent["p99_ms"] < 50.0),
+            "lock_wait_share": concurrent["split_fraction"]["lock_wait"],
+            "lock_wait_under_10pct": bool(
+                concurrent["split_fraction"]["lock_wait"] < 0.10
+            ),
+            "shadow_no_alert": shadow_report["no_alert"],
+        }
+        counters = dict(tier.counters)
+        return {
+            "bucket_minutes": _TT_G,
+            "epochs_sealed": tier.sealed_through - (_TT_BASE_MIN // _TT_G) + 1,
+            "spans": len(spans_all),
+            "ingest_wall_s": round(ingest_wall, 2),
+            "segments": {
+                "fine": counters.get("ttSegmentsFine", 0),
+                "coarse": counters.get("ttSegmentsCoarse", 0),
+                "disk": counters.get("ttSegmentsDisk", 0),
+            },
+            "merge_cost": merge_cost,
+            "shadow_windowed": shadow_report,
+            "concurrent_windowed_8t": concurrent,
+            "slo": slo,
+        }
+    finally:
+        store.close()
+        shutil.rmtree(arch, ignore_errors=True)
 
 
 def main() -> None:
@@ -644,6 +948,11 @@ def main() -> None:
         },
     }
 
+    # -- time-disaggregated sketch tier (ISSUE 15) -----------------------
+    timetier = _timetier_section(
+        bool(os.environ.get("QUERY_SLO_SMALL")), qs
+    )
+
     out = {
         "artifact": "query_slo",
         "spans": sent,
@@ -661,6 +970,8 @@ def main() -> None:
         "concurrent": concurrent,
         "mirror_parity": parity,
         "slo_concurrent_mirror": slo_concurrent,
+        "timetier": timetier,
+        "slo_windowed": timetier["slo"],
         "dependency_edges_transfer_ab": edges_ab,
         "program_device_ms_per_dispatch": program_ms,
         "incremental_ctx": ctx_report,

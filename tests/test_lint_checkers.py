@@ -434,6 +434,70 @@ def test_zt07_pragma_with_delta_bound_suppresses(tmp_path):
     assert [f.rule for f in result.suppressed] == ["ZT07"]
 
 
+# -- ZT07 windowed fence: no archive scans from windowed entrypoints ----
+
+
+ZT07_WINDOWED_POSITIVE = """
+    class Store:
+        def trace_cardinalities(self, end_ts=None, lookback=None):
+            if end_ts is not None:
+                return self._backfill(end_ts, lookback)
+            return self._rows()
+
+        def _backfill(self, end_ts, lookback):
+            # the tempting regression: answer an uncovered window by
+            # rescanning the span archive
+            return self._disk_query((end_ts, lookback))
+"""
+
+
+def test_zt07_flags_archive_scan_from_windowed_entrypoint(tmp_path):
+    # note: NO jax import in the fixture — the windowed fence is
+    # ungated, because the windowed routing layer is pure host code
+    assert_rule_owned(tmp_path, ZT07_WINDOWED_POSITIVE, "ZT07")
+
+
+def test_zt07_archive_scan_on_trace_retrieval_path_is_clean(tmp_path):
+    # the scanners themselves ARE the getTraces path — only windowed
+    # entrypoints reaching them is the violation
+    result = lint(
+        tmp_path,
+        """
+        class Store:
+            def get_traces_query(self, request):
+                return self._disk_query(request)
+
+            def _disk_query(self, request):
+                return self.candidate_trace_ids(request)
+
+            def candidate_trace_ids(self, request):
+                return []
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt07_windowed_segment_merge_is_clean(tmp_path):
+    # the shipped shape: windowed entrypoints merge covering time-tier
+    # segments through the mirror-keyed window read
+    result = lint(
+        tmp_path,
+        """
+        class Store:
+            def latency_quantiles(self, qs, end_ts=None, lookback=None):
+                lo_ep, hi_ep = self._tt_epochs(end_ts, lookback)
+                return self._tt_window(lo_ep, hi_ep)
+
+            def _tt_epochs(self, end_ts, lookback):
+                return 0, 1
+
+            def _tt_window(self, lo_ep, hi_ep):
+                return self.timetier.window(self.agg, lo_ep, hi_ep)
+        """,
+    )
+    assert rules(result) == []
+
+
 # -- pragmas and ZT00 ----------------------------------------------------
 
 
@@ -1165,6 +1229,24 @@ def test_zt10_ignores_unmarked_and_private_locks(tmp_path):
         """,
     )
     assert rules(result) == []
+
+
+def test_zt10_flags_tt_read_from_mirror_served(tmp_path):
+    # ISSUE 15: the unsealed-bucket device pull (tt_read) flushes then
+    # reads under the aggregator lock — a windowed serve must come off
+    # the published ttq: WindowAnswer, not recompute per request
+    assert_rule_owned(
+        tmp_path,
+        """
+        class Store:
+            def serve_window(self, lo_ep, hi_ep):  # zt-mirror-served: published ttq: answer only
+                return self._merge(lo_ep, hi_ep)
+
+            def _merge(self, lo_ep, hi_ep):
+                return self.agg.tt_read(lo_ep, hi_ep)
+        """,
+        "ZT10",
+    )
 
 
 def test_zt10_marker_without_reason_is_flagged(tmp_path):

@@ -1,0 +1,369 @@
+"""Time-disaggregated sketch tier (ISSUE 15).
+
+The contract under test: a windowed ``[lookback, endTs]`` query answers
+from merged time-bucket segments and is BIT-IDENTICAL to a from-scratch
+oracle store that ingested only that range's spans — and stays
+bit-identical across seal-crash resume (the timetier.seal.* crashpoints
+ride the PR 7/8 snapshot+WAL machinery). Bit rot in a sealed segment
+must cost coverage (quarantine), never a silently-wrong percentile.
+Satellite coverage: bucket-aligned mirror-key canonicalization (1000
+distinct endTs values collapse to a handful of ``ttq:`` registrations)
+and the windowed shadow-accuracy gauges staying NO-ALERT on an honest
+tier.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import random
+
+import numpy as np
+import pytest
+
+from zipkin_tpu import faults
+from zipkin_tpu.model.span import Endpoint, Kind, Span
+from zipkin_tpu.obs.accuracy import AccuracyEstimator
+from zipkin_tpu.obs.shadow import HostShadow
+from zipkin_tpu.storage.tpu import TpuStorage
+from zipkin_tpu.tpu.state import AggConfig
+
+G = 5   # time_bucket_minutes
+W = 4   # time_buckets (device ring slots)
+BASE_MIN = 10_000_000          # minutes; divisible by G
+BASE_EP = BASE_MIN // G
+N_SVC = 6
+N_OPS = 8
+
+CFG = AggConfig(
+    max_services=64, max_keys=256, hll_precision=8, digest_centroids=16,
+    digest_buffer=4096, ring_capacity=4096, link_buckets=4,
+    bucket_minutes=60, hist_slices=2,
+    time_buckets=W, time_bucket_minutes=G,
+)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    faults.disarm()
+
+
+def make(tmp_path, wal=False, archive=False):
+    return TpuStorage(
+        config=CFG, num_devices=2, batch_size=512,
+        checkpoint_dir=str(tmp_path / "ckpt") if wal else None,
+        wal_dir=str(tmp_path / "wal") if wal else None,
+        archive_dir=str(tmp_path / "arch") if archive else None,
+    )
+
+
+_SVCS = [Endpoint.create(f"svc{i}", f"10.0.0.{i + 1}") for i in range(N_SVC)]
+
+
+def warmup_spans():
+    """One span per (service, op) pair, in a FIXED order, stamped two
+    epochs before the test range. Both the live store and every oracle
+    ingest this prefix first, so vocab/key-row id assignment is
+    identical regardless of which span subset follows — a precondition
+    for comparing raw [K, ...] sketch planes bit-for-bit. The warmup
+    epoch falls out of the W-slot device ring before sealing starts and
+    is never part of a queried window."""
+    t_min = BASE_MIN - 2 * G
+    out = []
+    for i in range(N_SVC):
+        for j in range(N_OPS):
+            seq = i * N_OPS + j + 1
+            out.append(Span.create(
+                trace_id=f"{0xA0000 + seq:016x}",
+                id=f"{seq:016x}",
+                name=f"op{j}",
+                kind=Kind.CLIENT,
+                local_endpoint=_SVCS[i],
+                remote_endpoint=_SVCS[(i + 1) % N_SVC],
+                timestamp=t_min * 60_000_000,
+                duration=1000,
+            ))
+    return out
+
+
+def epoch_spans(ep_offsets, per=100, seed=0):
+    """Client chains (parent->child across services, so link edges
+    exist) with timestamps inside the given bucket epochs (offsets from
+    BASE_EP). ~2% error tags exercise the errs plane."""
+    rng = random.Random(seed)
+    spans = []
+    seq = 0
+    for off in ep_offsets:
+        for _ in range(per):
+            seq += 1
+            trace_id = f"{rng.getrandbits(63) | 1:016x}"
+            t_min = BASE_MIN + off * G + rng.randrange(G)
+            ts = t_min * 60_000_000 + rng.randrange(1000)
+            parent_id = None
+            caller = rng.randrange(N_SVC)
+            for level in range(rng.randint(1, 3)):
+                span_id = f"{(seq << 8 | level) + 1:016x}"
+                err = {"error": "boom"} if rng.random() < 0.02 else {}
+                spans.append(Span.create(
+                    trace_id=trace_id, id=span_id, parent_id=parent_id,
+                    name=f"op{rng.randrange(N_OPS)}",
+                    kind=Kind.CLIENT,
+                    local_endpoint=_SVCS[(caller + level) % N_SVC],
+                    remote_endpoint=_SVCS[(caller + level + 1) % N_SVC],
+                    timestamp=ts,
+                    duration=int(rng.paretovariate(1.2) * 1000) + 50,
+                    tags=err,
+                ))
+                parent_id = span_id
+    return spans
+
+
+def sealer_driver(off):
+    """One span in epoch ``off`` — drives the sealer past the epochs
+    under test (an epoch seals once ingest touches a NEWER one). Lives
+    outside every compared window, so it never contributes to a
+    windowed answer; reuses warmup's (svc0, op0) so vocab/key-row id
+    assignment stays identical."""
+    t_min = BASE_MIN + off * G
+    return [Span.create(
+        trace_id=f"{0xFEED:016x}", id=f"{0xFEED:016x}",
+        name="op0", kind=Kind.CLIENT,
+        local_endpoint=_SVCS[0], remote_endpoint=_SVCS[1],
+        timestamp=t_min * 60_000_000, duration=777,
+    )]
+
+
+def window_bounds_ms(lo_off, hi_off):
+    """(end_ts, lookback) in ms whose epoch_minutes//G round to exactly
+    [BASE_EP + lo_off, BASE_EP + hi_off] — the canonicalization the
+    store applies to every windowed route."""
+    end_ts = (BASE_MIN + (hi_off + 1) * G) * 60_000 - 1
+    lookback = (hi_off - lo_off + 1) * G * 60_000 - 60_000
+    return end_ts, lookback
+
+
+def assert_answers_equal(a, b):
+    np.testing.assert_array_equal(a.digest, b.digest)
+    np.testing.assert_array_equal(a.hll, b.hll)
+    np.testing.assert_array_equal(a.calls, b.calls)
+    np.testing.assert_array_equal(a.errs, b.errs)
+    assert a.covered == b.covered
+
+
+# -- seal protocol -------------------------------------------------------
+
+
+def test_seal_protocol_and_counters(tmp_path):
+    store = make(tmp_path)
+    store.accept(warmup_spans() + epoch_spans([0, 1, 2, 3])).execute()
+    assert store.agg.tt_max_epoch == BASE_EP + 3
+    assert store.timetier.seal_due(store.agg) == 3
+    assert store.tt_seal() == 3
+    assert store.timetier.sealed_through == BASE_EP + 2
+    assert store.timetier.seal_due(store.agg) == 0
+    assert store.tt_seal() == 0  # idempotent: nothing newly due
+    c = store.ingest_counters()
+    assert c["ttSeals"] == 3
+    assert c["ttSegmentsFine"] == 3
+    # sealed-only window: no device read; unsealed suffix flags
+    sealed = store.timetier.window(store.agg, BASE_EP, BASE_EP + 2)
+    assert not sealed.unsealed and sealed.covered == 3
+    mixed = store.timetier.window(store.agg, BASE_EP + 2, BASE_EP + 3)
+    assert mixed.unsealed and mixed.covered == 2
+
+
+def test_windowed_counts_are_exact(tmp_path):
+    spans = epoch_spans([0, 1, 2], per=80, seed=11)
+    store = make(tmp_path)
+    store.accept(warmup_spans() + spans).execute()
+    store.tt_seal()
+    for lo_off, hi_off in [(0, 0), (0, 1), (1, 2), (0, 2)]:
+        end_ts, lookback = window_bounds_ms(lo_off, hi_off)
+        rows = store.latency_quantiles(
+            [0.5, 0.99], end_ts=end_ts, lookback=lookback
+        )
+        want = sum(
+            1 for s in spans
+            if lo_off <= (s.timestamp // 60_000_000 - BASE_MIN) // G <= hi_off
+        )
+        assert sum(r["count"] for r in rows) == want
+
+
+# -- bit-identity vs a from-scratch oracle (the tentpole acceptance) -----
+
+
+def test_windowed_answers_match_from_scratch_oracle_fuzz(tmp_path):
+    spans = epoch_spans([0, 1, 2, 3], per=90, seed=7)
+    live = make(tmp_path / "live")
+    live.accept(warmup_spans() + spans).execute()
+    assert live.tt_seal() == 3
+
+    rng = random.Random(99)
+    ranges = [(0, 0), (1, 2), (0, 2)]
+    ranges += [tuple(sorted(rng.sample(range(3), 2))) for _ in range(2)]
+    for i, (lo_off, hi_off) in enumerate(ranges):
+        sub = [
+            s for s in spans
+            if lo_off <= (s.timestamp // 60_000_000 - BASE_MIN) // G <= hi_off
+        ]
+        oracle = make(tmp_path / f"oracle{i}")
+        # only the range's spans — same warmup prefix, same relative
+        # span order, same (single, seal-time) digest flush position;
+        # the driver span in epoch hi+1 lets the oracle seal epoch hi
+        # (the live store's later epochs played that role for it)
+        oracle.accept(
+            warmup_spans() + sub + sealer_driver(hi_off + 1)
+        ).execute()
+        oracle.tt_seal()
+        assert oracle.timetier.sealed_through >= BASE_EP + hi_off
+        a = live.timetier.window(live.agg, BASE_EP + lo_off, BASE_EP + hi_off)
+        b = oracle.timetier.window(
+            oracle.agg, BASE_EP + lo_off, BASE_EP + hi_off
+        )
+        assert_answers_equal(a, b)
+        # and through the public windowed routes
+        end_ts, lookback = window_bounds_ms(lo_off, hi_off)
+        assert live.latency_quantiles(
+            [0.5, 0.95, 0.99], end_ts=end_ts, lookback=lookback
+        ) == oracle.latency_quantiles(
+            [0.5, 0.95, 0.99], end_ts=end_ts, lookback=lookback
+        )
+        assert live.trace_cardinalities(
+            end_ts=end_ts, lookback=lookback
+        ) == oracle.trace_cardinalities(end_ts=end_ts, lookback=lookback)
+        got = live.get_dependencies(end_ts, lookback).execute()
+        want = oracle.get_dependencies(end_ts, lookback).execute()
+        assert sorted(map(str, got)) == sorted(map(str, want))
+        oracle.close()
+    live.close()
+
+
+# -- seal crashpoints: durability parity (satellite 3) -------------------
+
+
+@pytest.mark.parametrize("site,adopted", [
+    ("timetier.seal.pre_commit", 0),   # tmp file only: reseal all
+    ("timetier.seal.post_commit", 1),  # npz committed: boot adopts it
+])
+def test_seal_crash_resume_is_bit_identical(tmp_path, site, adopted):
+    spans = warmup_spans() + epoch_spans([0, 1, 2, 3], per=70, seed=3)
+    oracle = make(tmp_path / "o")
+    oracle.accept(spans).execute()
+    assert oracle.tt_seal() == 3
+
+    victim = make(tmp_path, wal=True, archive=True)
+    victim.accept(spans).execute()
+    faults.arm(site, nth=1, action="raise")
+    with pytest.raises(faults.CrashpointTriggered):
+        victim.tt_seal()
+    del victim  # crash: HBM state gone; WAL + committed segments remain
+
+    revived = make(tmp_path, wal=True, archive=True)
+    # pre_commit left only a tmp file (cleaned at boot, nothing
+    # adopted); post_commit left a committed npz that boot MUST adopt
+    assert revived.timetier.sealed_through == (
+        BASE_EP + adopted - 1 if adopted else -1
+    )
+    assert revived.tt_seal() == 3 - adopted
+    assert revived.timetier.sealed_through == BASE_EP + 2
+    # no stray tmp files survive boot
+    tdir = os.path.join(str(tmp_path), "arch", "timetier")
+    assert not glob.glob(os.path.join(tdir, "*.tmp"))
+    for lo_off, hi_off in [(0, 2), (1, 1), (0, 1)]:
+        a = revived.timetier.window(
+            revived.agg, BASE_EP + lo_off, BASE_EP + hi_off
+        )
+        b = oracle.timetier.window(
+            oracle.agg, BASE_EP + lo_off, BASE_EP + hi_off
+        )
+        assert_answers_equal(a, b)
+    end_ts, lookback = window_bounds_ms(0, 2)
+    assert revived.latency_quantiles(
+        [0.5, 0.99], end_ts=end_ts, lookback=lookback
+    ) == oracle.latency_quantiles(
+        [0.5, 0.99], end_ts=end_ts, lookback=lookback
+    )
+    oracle.close()
+    revived.close()
+
+
+# -- segment bit rot: quarantine, not garbage (satellite 3) --------------
+
+
+@pytest.mark.parametrize("mode", ["flip", "zero", "truncate"])
+def test_segment_bit_rot_is_quarantined(tmp_path, mode):
+    store = make(tmp_path, archive=True)
+    store.accept(warmup_spans() + epoch_spans([0, 1, 2, 3], per=60)).execute()
+    faults.arm_corrupt("timetier.segment", mode=mode, nth=2)
+    assert store.tt_seal() == 3  # middle epoch's npz damaged at rest
+    store.close()
+
+    fresh = make(tmp_path, archive=True)  # boot adopts the disk epochs
+    tier = fresh.timetier
+    assert tier.sealed_through == BASE_EP + 2
+    ans = tier.window(fresh.agg, BASE_EP, BASE_EP + 2)
+    # the rotted bucket costs coverage — never a silently-wrong answer
+    assert ans.missing == 1 and ans.covered == 2
+    assert tier.counters["ttSegmentsQuarantined"] == 1
+    tdir = os.path.join(str(tmp_path), "arch", "timetier")
+    assert glob.glob(os.path.join(tdir, "*.quarantine"))
+    # quarantine is sticky: the epoch stays missing on re-read
+    again = tier.window(fresh.agg, BASE_EP, BASE_EP + 2)
+    assert again.missing == 1 and again.covered == 2
+    fresh.close()
+
+
+# -- mirror-key canonicalization (satellite 2) ---------------------------
+
+
+def test_thousand_end_ts_values_collapse_to_few_mirror_keys(tmp_path):
+    store = make(tmp_path)
+    store.accept(warmup_spans() + epoch_spans([0, 1, 2], per=60)).execute()
+    store.tt_seal()
+    lookback = G * 60_000
+    # 1000 DISTINCT endTs values sweeping ~two sealed buckets — a
+    # polling client stepping endTs by the second
+    start = (BASE_MIN + G) * 60_000
+    for i in range(1000):
+        end_ts = start + i * 577  # 577 ms steps, all distinct
+        store.trace_cardinalities(end_ts=end_ts, lookback=lookback)
+    ttq_keys = [k for k in store.mirror._demand if k.startswith("ttq:")]
+    # bucket-aligned canonicalization: distinct endTs count is
+    # irrelevant; only distinct (lo_ep, hi_ep) pairs register
+    assert len(ttq_keys) <= 4
+    assert len(ttq_keys) <= store.mirror.max_keys
+    assert store.mirror.demand_overflow == 0
+    store.close()
+
+
+# -- windowed shadow accuracy (satellite 1) ------------------------------
+
+
+def test_windowed_accuracy_gauges_no_alert_on_honest_tier(tmp_path):
+    spans = warmup_spans() + epoch_spans([0, 1, 2], per=120, seed=5)
+    store = make(tmp_path)
+    store.accept(spans).execute()
+    store.tt_seal()
+    shadow = HostShadow(
+        bucket_minutes=G,
+        link_rate=0.0,
+        seed=2,
+        svc_resolver=store.vocab.services.get,
+    )
+    shadow.offer_spans(spans)
+    shadow.drain()
+    assert shadow.counters()["shadowWindowEpochs"] >= 3
+    acc = AccuracyEstimator(store, shadow, rollup_s=0.0)
+    g = acc.rollup()
+    # the tier's newest sealed bucket vs that bucket's exact shadow
+    # sub-stream: errors bounded, drift gauges quiet (the default
+    # windowed SloSpecs watch the drift gauges)
+    assert g["accuracyWindowedDigestP99RelErr"] < 0.25
+    assert g["accuracyWindowedDigestP99Drift"] < 0.20
+    assert g["accuracyWindowedHllRelErr"] < 0.15
+    assert g["accuracyWindowedHllDrift"] == pytest.approx(0.0)
+    detail = acc.status()["windowed"]
+    assert detail["epoch"] <= BASE_EP + 2
+    assert "digest" in detail and "distinct" in detail
+    store.close()

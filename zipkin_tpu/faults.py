@@ -90,11 +90,19 @@ SITES = (
     "snapshot.post_state",
     "snapshot.post_meta",
     "archive.mid_segment",
+    # time-tier bucket seal (tpu/timetier.py): pre_commit fires after
+    # the segment tmp file is written but BEFORE the atomic rename
+    # (crash leaves no segment — reseal on resume); post_commit fires
+    # after the rename but before sealed_through advances (crash leaves
+    # a committed segment the resume must adopt idempotently)
+    "timetier.seal.pre_commit",
+    "timetier.seal.post_commit",
 )
 CORRUPT_SITES = (
     "snapshot.state",
     "wal.record",
     "archive.frame",
+    "timetier.segment",
 )
 CORRUPT_MODES = ("flip", "truncate", "zero")
 RESOURCE_SITES = (

@@ -31,6 +31,18 @@ The ONE legitimate sort on the fresh path — the delta-segment sort in
 ``delta_linker._resolve_core`` — carries a ZT07 pragma whose reason
 states the bound (``sorts only the 2·Δ delta-segment lanes``); the
 pragma-with-reason mechanism (ZT00) keeps that claim reviewable.
+
+ISSUE 15 added a second fenced surface with the same failure shape at a
+different tier: windowed sketch queries (``[lookback, endTs]`` on the
+quantile/cardinality/dependency routes) answer by merging sealed
+time-bucket segments (``tpu/timetier.py``) — compact host-side numpy
+over O(W) segments. The tempting regression is a "helpful" fallback
+that answers an uncovered window by rescanning the span archive
+(``candidate_trace_ids`` / ``_disk_query`` — O(archive) wall per
+query, exactly the cost the tier exists to avoid; uncovered epochs are
+reported as coverage gaps instead). That walk is UNGATED on jax
+imports: the windowed routing layer is pure host code and must stay
+fenced even if it moves out of a jax-importing module.
 """
 
 from __future__ import annotations
@@ -61,6 +73,21 @@ SORT_SCAN_ROOTS = {"jax", "jnp", "lax"}
 # the from-scratch oracle surface (ops/linker.py)
 FULL_REBUILDERS = {"link_context", "resolve_parents"}
 
+# windowed sketch-tier entrypoints (tpu/store.py, ISSUE 15): queries
+# carrying a [lookback, endTs] range answer from merged time-bucket
+# segments — same per-module seeding rule as the fresh-read set
+WINDOWED_ENTRYPOINTS = {
+    "latency_quantiles",
+    "trace_cardinalities",
+    "_get_dependencies",
+    "_tt_window",
+}
+
+# the full-archive scan surface (tpu/store.py → tpu/archive.py):
+# correct for trace retrieval, catastrophic as a windowed-sketch
+# fallback — O(archive) wall per query
+ARCHIVE_SCANNERS = {"candidate_trace_ids", "_disk_query"}
+
 
 def _callee_name(func: ast.AST):
     if isinstance(func, ast.Name):
@@ -68,6 +95,29 @@ def _callee_name(func: ast.AST):
     if isinstance(func, ast.Attribute):
         return func.attr
     return None
+
+
+def _reach(defs, roots):
+    """Conservative local reachability: def node -> (node, seed name).
+
+    Bare-name and attribute calls both descend when a local def
+    matches — over-approximate rather than miss a helper; cross-module
+    edges can't be followed, so each module on a fenced path names its
+    own entrypoints.
+    """
+    reached = {}
+    stack = [(d, d.name) for d in roots]
+    while stack:
+        fn, root = stack.pop()
+        if fn.name in reached:
+            continue
+        reached[fn.name] = (fn, root)
+        for call in ast.walk(fn):
+            if isinstance(call, ast.Call):
+                tgt = defs.get(_callee_name(call.func))
+                if tgt is not None and tgt.name not in reached:
+                    stack.append((tgt, root))
+    return reached
 
 
 @register
@@ -86,31 +136,23 @@ class FreshReadRingSort(Checker):
     )
 
     def check(self, module: Module):
-        if not module.imported_roots & {"jax", "jnp"}:
-            return
         defs = {}
         for node in ast.walk(module.tree):
             if isinstance(node, _FUNC_KINDS):
                 defs.setdefault(node.name, node)
-        roots = [d for n, d in defs.items() if n in FRESH_READ_ENTRYPOINTS]
-        if not roots:
-            return
-        # reachability over local defs (name-keyed, attribute calls
-        # included: over-approximate rather than miss a helper)
-        reached = {}  # def node -> entrypoint name that reaches it
-        stack = [(d, d.name) for d in roots]
-        while stack:
-            fn, root = stack.pop()
-            if fn.name in reached:
-                continue
-            reached[fn.name] = (fn, root)
-            for call in ast.walk(fn):
-                if isinstance(call, ast.Call):
-                    tgt = defs.get(_callee_name(call.func))
-                    if tgt is not None and tgt.name not in reached:
-                        stack.append((tgt, root))
-        for fn, root in reached.values():
-            yield from self._scan_function(module, fn, root)
+        # walk 1 — fresh-read sort fence, gated on jax imports (the
+        # hazard is a device sort/scan; a jax-free module can't emit one)
+        if module.imported_roots & {"jax", "jnp"}:
+            roots = [
+                d for n, d in defs.items() if n in FRESH_READ_ENTRYPOINTS
+            ]
+            for fn, root in _reach(defs, roots).values():
+                yield from self._scan_function(module, fn, root)
+        # walk 2 — windowed archive-scan fence, UNGATED: the windowed
+        # routing layer is pure host code (see module docstring)
+        w_roots = [d for n, d in defs.items() if n in WINDOWED_ENTRYPOINTS]
+        for fn, root in _reach(defs, w_roots).values():
+            yield from self._scan_windowed(module, fn, root)
 
     def _scan_function(self, module: Module, fn: ast.AST, root: str):
         for node in ast.walk(fn):
@@ -138,4 +180,25 @@ class FreshReadRingSort(Checker):
                     f"from-scratch rebuilder {name}() called from "
                     f"fresh-read entrypoint {root}(){where} — use the "
                     "incremental delta formulation",
+                )
+
+    def _scan_windowed(self, module: Module, fn: ast.AST, root: str):
+        if fn.name in ARCHIVE_SCANNERS:
+            # the scanners themselves (and their internals) are the
+            # trace-retrieval path — only CALLS INTO them from the
+            # windowed surface are the violation
+            return
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node.func)
+            if name in ARCHIVE_SCANNERS:
+                where = "" if fn.name == root else f" (via {fn.name}())"
+                yield self.found(
+                    module,
+                    node,
+                    f"archive scanner {name}() reachable from windowed "
+                    f"entrypoint {root}(){where} — windowed queries must "
+                    "merge sealed time-bucket segments (coverage gaps "
+                    "are reported, not backfilled by archive rescans)",
                 )

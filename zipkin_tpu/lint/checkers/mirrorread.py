@@ -61,6 +61,13 @@ LOCK_TAKERS = frozenset({
     "window_fully_rolled",
     "state_clone",
     "sync_pend_lanes",
+    # ISSUE 15 time tier: the packed device pull of the unsealed
+    # current bucket acquires the aggregator lock (flush-then-read),
+    # and TimeTier.window() reaches it for any range past
+    # sealed_through — windowed serves must come off the published
+    # ``ttq:`` WindowAnswer, never recompute the merge per request
+    "tt_read",
+    "tt_sketches",
 })
 
 

@@ -63,7 +63,7 @@ import numpy as np
 
 from zipkin_tpu import obs
 from zipkin_tpu.obs.shadow import HostShadow
-from zipkin_tpu.ops import hll
+from zipkin_tpu.ops import hll, ttmerge
 from zipkin_tpu.ops.tdigest import cluster_q_width
 
 _FULL_LO_MIN = 0
@@ -122,6 +122,10 @@ class AccuracyEstimator:
             "accuracyHllRelErr": 0.0,
             "accuracyHllBound": 0.0,
             "accuracyHllDrift": 0.0,
+            "accuracyWindowedDigestP99RelErr": 0.0,
+            "accuracyWindowedDigestP99Drift": 0.0,
+            "accuracyWindowedHllRelErr": 0.0,
+            "accuracyWindowedHllDrift": 0.0,
             "accuracyLinkRecall": 1.0,
             "accuracyRetentionBias": 0.0,
             "accuracyShadowCoverage": 1.0,
@@ -168,13 +172,18 @@ class AccuracyEstimator:
         hll_err = hll_bound = 0.0
         recall = 1.0
         ret_bias = 0.0
+        w_digest_err = w_digest_drift = 0.0
+        w_hll_err = w_hll_drift = 0.0
         links_detail: Dict = {}
         distinct_detail: Dict = {}
+        windowed_detail: Dict = {}
 
         if not suppressed:
             (services, p50_err, p99_err, p99_bound,
              p50_drift, p99_drift) = self._digest_errors()
             hll_err, hll_bound, distinct_detail = self._hll_error()
+            (w_digest_err, w_digest_drift, w_hll_err, w_hll_drift,
+             windowed_detail) = self._windowed_errors()
             recall, links_detail = self._link_recall()
             ret_bias = self._retention_bias()
 
@@ -190,6 +199,10 @@ class AccuracyEstimator:
             "accuracyHllRelErr": hll_err,
             "accuracyHllBound": hll_bound,
             "accuracyHllDrift": max(0.0, hll_err - hll_bound),
+            "accuracyWindowedDigestP99RelErr": w_digest_err,
+            "accuracyWindowedDigestP99Drift": w_digest_drift,
+            "accuracyWindowedHllRelErr": w_hll_err,
+            "accuracyWindowedHllDrift": w_hll_drift,
             "accuracyLinkRecall": recall,
             "accuracyRetentionBias": ret_bias,
             "accuracyShadowCoverage": coverage,
@@ -202,6 +215,7 @@ class AccuracyEstimator:
                 "services": services,
                 "links": links_detail,
                 "distinct": distinct_detail,
+                "windowed": windowed_detail,
                 "suppressed": suppressed,
             }
         return gauges
@@ -313,6 +327,69 @@ class AccuracyEstimator:
             "shadow": sh,
             "kept": int(kept),
         }
+
+    def _windowed_errors(self) -> Tuple[float, float, float, float, Dict]:
+        """Windowed accuracy (ISSUE 15): audit the time tier's newest
+        SEALED bucket for which the windowed shadow holds exact
+        sub-streams — the tier's per-bucket digest p99 vs the bucket's
+        exact reservoir, and the bucket's HLL estimate vs the bucket's
+        KMV sketch. Same estimator shapes (and the same drift-over-
+        noise alert semantics) as the cumulative pair, so the default
+        windowed SloSpecs page on real sketch drift, not sampling
+        noise. Sealed-only by construction: a sealed segment never
+        changes, so this read takes no aggregator lock."""
+        shadow = self._shadow
+        store = self._store
+        tier = getattr(store, "timetier", None)
+        if tier is None or shadow.bucket_minutes <= 0:
+            return 0.0, 0.0, 0.0, 0.0, {}
+        eps = [
+            e for e in shadow.window_epochs() if e <= tier.sealed_through
+        ]
+        if not eps:
+            return 0.0, 0.0, 0.0, 0.0, {}
+        epoch = eps[-1]
+        ans = tier.window(store.agg, epoch, epoch)
+        d_err = d_drift = h_err = h_drift = 0.0
+        detail: Dict = {"epoch": int(epoch)}
+        res = shadow.window_reservoir(epoch)
+        if res is not None and res.seen >= self.min_count:
+            vals = res.values()
+            k = len(vals)
+            q = 0.99
+            dev_q, total = _digest_quantile(np.asarray(ans.digest)[1:], q)
+            if total >= self.min_count:
+                sq = float(np.quantile(vals, q))
+                d_err = abs(dev_q - sq) / max(sq, 1.0)
+                noise = 3.0 * math.sqrt(q * (1.0 - q) / k)
+                nlo, nhi = np.quantile(
+                    vals, [max(0.0, q - noise), min(1.0, q + noise)]
+                )
+                noise_bound = (
+                    max(float(nhi) - sq, sq - float(nlo)) / max(sq, 1.0)
+                    + 0.005
+                )
+                d_drift = max(0.0, d_err - noise_bound)
+                detail["digest"] = {
+                    "device": dev_q, "shadow": sq, "reservoirSeen": res.seen,
+                }
+        sk = shadow.window_distinct(epoch)
+        if sk is not None and len(sk.ids) >= self.min_count:
+            dev = float(
+                ttmerge.hll_estimate(np.asarray(ans.hll))[
+                    store.config.global_hll_row
+                ]
+            )
+            sh = sk.estimate()
+            h_err = abs(dev - sh) / max(sh, 1.0)
+            bound = (
+                3.0 * hll.standard_error(store.config.hll_precision)
+                + hll.bias_fraction(max(dev, 1.0))
+                + sk.rel_bound()
+            )
+            h_drift = max(0.0, h_err - bound)
+            detail["distinct"] = {"device": dev, "shadow": sh}
+        return d_err, d_drift, h_err, h_drift, detail
 
     def _link_recall(self) -> Tuple[float, Dict]:
         """Replay the shadow's sampled traces through the host linker

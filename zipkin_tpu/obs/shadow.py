@@ -50,7 +50,7 @@ from __future__ import annotations
 
 import math
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -190,6 +190,8 @@ class HostShadow:
         seed: int = 0xACC0,
         sampler_ref: Optional[Callable[[], object]] = None,
         svc_resolver: Optional[Callable[[str], Optional[int]]] = None,
+        bucket_minutes: int = 0,
+        window_slots: int = 8,
     ) -> None:
         self.reservoir_k = int(reservoir_k)
         self.distinct_k = int(distinct_k)
@@ -207,6 +209,14 @@ class HostShadow:
         # shadow must not pin one instance.
         self._sampler_ref = sampler_ref or (lambda: None)
         self._svc_resolver = svc_resolver or (lambda name: None)
+        # windowed ground truth (ISSUE 15): when bucket_minutes > 0 the
+        # shadow also keeps PER-TIME-BUCKET exact sub-streams — a global
+        # duration reservoir and a KMV distinct sketch per epoch, a ring
+        # of the most recent window_slots epochs — so the accuracy plane
+        # can audit the time tier's sealed segments the same way the
+        # cumulative estimators audit the all-time sketches.
+        self.bucket_minutes = int(bucket_minutes)
+        self.window_slots = int(window_slots)
         self._pending: deque = deque()
         self._dropped_batches = 0
         self._offered_batches = 0
@@ -225,6 +235,9 @@ class HostShadow:
         self._total_seen = 0
         self._ret_seen = 0
         self._ret_kept = 0
+        # per-epoch windowed sub-streams, oldest-first insertion order
+        self._win_res: "OrderedDict[int, _Reservoir]" = OrderedDict()
+        self._win_distinct: "OrderedDict[int, _DistinctSketch]" = OrderedDict()
 
     def reset(self) -> None:
         """Start a fresh shadow window (e.g. after the operator rotates
@@ -288,6 +301,7 @@ class HostShadow:
             cols.trace_h, cols.tl0, cols.tl1, cols.svc, cols.rsvc,
             cols.key, cols.dur, cols.has_dur, cols.err, cols.valid,
             cols.s0, cols.s1, cols.p0, cols.p1, cols.shared, cols.kind,
+            ts=cols.ts_min,
         )
 
     def _fold_fused(self, fused: np.ndarray) -> None:
@@ -311,6 +325,7 @@ class HostShadow:
             f[..., 6, :].reshape(-1),
             (kf & np.uint32(2)) != 0,
             ((kf >> np.uint32(4)) & np.uint32(0xF)).astype(np.int64),
+            ts=f[..., 8, :].reshape(-1),
         )
 
     def _fold_spans(self, spans: List) -> None:
@@ -344,6 +359,7 @@ class HostShadow:
         has_dur = np.zeros(n, bool)
         err = np.zeros(n, bool)
         valid = np.zeros(n, bool)
+        ts = np.zeros(n, np.uint32)
         for i, s in enumerate(spans):
             sid = self._svc_resolver(s.local_service_name) if s.local_service_name else None
             if not sid:
@@ -368,16 +384,17 @@ class HostShadow:
             dur[i] = min(int(d), 0xFFFFFFFF)
             has_dur[i] = d > 0
             err[i] = "error" in (s.tags or {})
+            ts[i] = min(int(s.timestamp or 0) // 60_000_000, 0xFFFFFFFF)
             valid[i] = True
         trace_h = _hash2_np(_hash2_np(tl0, tl1), _hash2_np(th0, th1))
         self._fold_lanes(
             trace_h, tl0, tl1, svc, rsvc, None, dur, has_dur, err, valid,
-            s0, s1, p0, p1, shared, kind,
+            s0, s1, p0, p1, shared, kind, ts=ts,
         )
 
     def _fold_lanes(
         self, trace_h, tl0, tl1, svc, rsvc, key, dur, has_dur, err, valid,
-        s0, s1, p0, p1, shared, kind,
+        s0, s1, p0, p1, shared, kind, ts=None,
     ) -> None:
         v = np.asarray(valid, bool)
         if not v.any():
@@ -417,6 +434,47 @@ class HostShadow:
         # distinct sub-stream (trace identity = low-64 id lanes)
         ids = (tl1.astype(np.uint64) << np.uint64(32)) | tl0.astype(np.uint64)
         self._distinct.add(np.unique(ids))
+        # per-time-bucket windowed sub-streams (ISSUE 15): the exact
+        # mirrors of the device's tb_* current-bucket sketches, keyed by
+        # the SAME epoch = ts_min // bucket_minutes the ingest step uses
+        if self.bucket_minutes > 0 and ts is not None:
+            eps = (
+                np.asarray(ts, np.int64)[v] // self.bucket_minutes
+            )
+            for e in np.unique(eps).tolist():
+                in_e = eps == e
+                res = self._win_res.get(e)
+                if res is None:
+                    # only track epochs newer than anything evicted —
+                    # a late straggler for a dropped epoch must not
+                    # resurrect it with a near-empty (biased) reservoir
+                    if (
+                        len(self._win_res) >= self.window_slots
+                        and e < next(iter(self._win_res))
+                    ):
+                        continue
+                    res = self._win_res[e] = _Reservoir(
+                        self.reservoir_k, self._rng
+                    )
+                    self._win_distinct[e] = _DistinctSketch(self.distinct_k)
+                sel_d = in_e & hd
+                if sel_d.any():
+                    res.add(dur[sel_d].astype(np.float64))
+                self._win_distinct[e].add(np.unique(ids[in_e]))
+            while len(self._win_res) > self.window_slots:
+                old, _ = self._win_res.popitem(last=False)
+                self._win_distinct.pop(old, None)
+            # keep insertion order == epoch order for the eviction rule
+            if len(self._win_res) > 1:
+                order = sorted(self._win_res)
+                if list(self._win_res) != order:
+                    self._win_res = OrderedDict(
+                        (e, self._win_res[e]) for e in order
+                    )
+                    self._win_distinct = OrderedDict(
+                        (e, self._win_distinct[e])
+                        for e in order if e in self._win_distinct
+                    )
         # sampled-trace span lanes for the host linker oracle: trace-
         # affine selection (pure function of the trace hash) keeps every
         # span of a sampled trace across batches and ingest paths
@@ -479,6 +537,20 @@ class HostShadow:
         with self._lock:
             return self._ret_seen, self._ret_kept
 
+    def window_epochs(self) -> List[int]:
+        """Epochs (ts_min // bucket_minutes) the windowed shadow holds,
+        ascending — empty when the windowed shadow is off."""
+        with self._lock:
+            return sorted(self._win_res)
+
+    def window_reservoir(self, epoch: int) -> Optional[_Reservoir]:
+        with self._lock:
+            return self._win_res.get(epoch)
+
+    def window_distinct(self, epoch: int) -> Optional[_DistinctSketch]:
+        with self._lock:
+            return self._win_distinct.get(epoch)
+
     def seen_by_service(self) -> Dict[int, int]:
         with self._lock:
             return dict(self._seen_by_svc)
@@ -499,6 +571,7 @@ class HostShadow:
                 "shadowDistinctKept": len(self._distinct.ids),
                 "shadowDistinctTheta": self._distinct.theta / _U32_SPACE,
                 "shadowLinkTraces": len(self._link_traces),
+                "shadowWindowEpochs": len(self._win_res),
                 "shadowPending": len(self._pending),
                 "shadowOfferedBatches": self._offered_batches,
                 "shadowDroppedBatches": self._dropped_batches,

@@ -112,6 +112,17 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
                 gauge="accuracyDigestP99Drift", limit=0.20, **kw),
         SloSpec("hll_relerr", "gauge",
                 gauge="accuracyHllDrift", limit=0.15, **kw),
+        # Windowed accuracy (ISSUE 15): the same drift-over-noise
+        # semantics evaluated against the time tier's newest sealed
+        # bucket — per-bucket digest p99 vs the bucket's exact shadow
+        # reservoir, per-bucket HLL vs its KMV sketch. Same limits as
+        # the cumulative pair: a sealed segment is the SAME sketch
+        # structure, so sustained unexplained error past them means the
+        # seal/merge path (not sampling noise) is corrupting windows.
+        SloSpec("windowed_digest_p99_relerr", "gauge",
+                gauge="accuracyWindowedDigestP99Drift", limit=0.20, **kw),
+        SloSpec("windowed_hll_relerr", "gauge",
+                gauge="accuracyWindowedHllDrift", limit=0.15, **kw),
         SloSpec("hll_envelope", "ratio", objective=0.99,
                 bad="hllEnvelopeExceeded", total="hostTransfers", **kw),
         # Critical-path tracer (obs/critpath.py): wire-to-durable is the

@@ -467,6 +467,45 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     )
     overview = _packed(overview_sm, "spmd_overview")
 
+    def spmd_ttread(ctx, state: AggState, lo_ep, hi_ep):
+        """Time-tier windowed read (tpu/timetier.py): each shard masks
+        its current-bucket leaves to the ``[lo_ep, hi_ep]`` bucket range
+        (edges ride the cached link ``ctx`` for the live-ring half),
+        then one cross-shard merge per sketch family — register-max for
+        HLL, row-parallel recluster for the digests (the
+        _gather_recluster idiom at the tier's own centroid count), psum
+        for the edge counts. The sealer calls it with lo==hi to freeze
+        one bucket into a segment; queries call it for the unsealed
+        suffix of a window. ONE dispatch, one packed pull."""
+        from zipkin_tpu.ops import tdigest
+
+        s = jax.tree_util.tree_map(lambda a: a[0], state)
+        c = jax.tree_util.tree_map(lambda a: a[0], ctx)
+        ep, regs, digest, calls, errs = ing.tt_sketches(
+            config, s, lo_ep, hi_ep, ctx=c
+        )
+        if n_shards > 1:
+            ep = jax.lax.pmax(ep, SHARD_AXIS)
+            regs = jax.lax.pmax(regs, SHARD_AXIS)
+            allc = jax.lax.all_gather(digest, SHARD_AXIS)
+            d = allc.shape[0]
+            k = config.max_keys
+            cw = config.time_digest_centroids
+            flat = jnp.moveaxis(allc, 0, 1).reshape(k, d * cw, 2)
+            digest = tdigest.row_merge(
+                jnp.zeros((k, cw, 2), jnp.float32), flat
+            )
+            calls = jax.lax.psum(calls, SHARD_AXIS)
+            errs = jax.lax.psum(errs, SHARD_AXIS)
+        return ep, regs, digest, calls, errs
+
+    ttread_sm = shard_map(
+        spmd_ttread, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()), out_specs=P(),
+        **_vma_off,
+    )
+    ttread = _packed(ttread_sm, "spmd_ttread")
+
     # the pre-pack (multi-output) jits, kept compilable for the packed
     # wire parity tests and the transfers-3→1 A/B in benchmarks — jit is
     # lazy, so an un-dispatched raw variant costs nothing
@@ -484,6 +523,7 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
         "quant_whist": jax.jit(quant_whist_sm),
         "card": jax.jit(card_sm),
         "overview": jax.jit(overview_sm),
+        "ttread": jax.jit(ttread_sm),
     }
     # Device-program observatory (obs/device.py): every dispatchable
     # program counts calls/compiles through a thin wrapper — the runtime
@@ -513,11 +553,12 @@ def _compiled_programs(config: AggConfig, mesh: Mesh):
     link_ctx = _w("spmd_link_ctx", link_ctx)
     snap_copy = _w("spmd_snap_copy", snap_copy)
     overview = _w("spmd_overview", overview)
+    ttread = _w("spmd_ttread", ttread)
     return (
         init, step_variants, links, merge, flush, rollup, whist, digest_read,
         edges, edges_fresh, edges_rolled, quant_digest, quant_digest_nopend,
         quant_hist, quant_whist, card, link_ctx, snap_copy, sharding,
-        overview, raw,
+        overview, ttread, raw,
     )
 
 
@@ -538,7 +579,7 @@ class ShardedAggregator:
             self._edges_fresh, self._edges_rolled, self._quant_digest,
             self._quant_digest_nopend, self._quant_hist, self._quant_whist,
             self._card, self._link_ctx, self._snap_copy, self._sharding,
-            self._overview, self._raw,
+            self._overview, self._ttread, self._raw,
         ) = _compiled_programs(config, mesh)
         self._step = self._step_variants[(False, False)]
         # device-resident LinkContext for the current write_version (the
@@ -592,6 +633,11 @@ class ShardedAggregator:
 
         self._resident: "deque" = deque()
         self._shard_cursor = np.zeros(self.n_shards, np.int64)
+        # Highest bucket epoch any ingested span has touched (host
+        # mirror, from the same ts_range the resident ledger uses). The
+        # time-tier sealer (tpu/timetier.py) seals epochs strictly below
+        # this — the max-epoch bucket is the UNSEALED current bucket.
+        self._tt_max_epoch = -1
         self.read_stats = {
             "rolled_only_reads": 0,
             "ctx_reads": 0,
@@ -701,6 +747,15 @@ class ShardedAggregator:
             # resident-range bookkeeping (see __init__); unknown range =
             # (0, 2^32-1), conservatively intersecting every window
             lo, hi = ts_range if ts_range is not None else (0, (1 << 32) - 1)
+            if (
+                n_spans > 0
+                and self.config.timetier_enabled
+                and ts_range is not None
+            ):
+                self._tt_max_epoch = max(
+                    self._tt_max_epoch,
+                    int(hi) // self.config.time_bucket_minutes,
+                )
             if n_spans > 0:
                 # per-shard live counts straight from the wire image's
                 # valid bits (row 10 bit 0) — the ring cursor advances by
@@ -889,6 +944,25 @@ class ShardedAggregator:
         invalidating every other cached answer."""
         self.state = self._flush(self.state)
         self._pend_lanes = 0
+        self._wal_marker("ttflush")
+
+    def _wal_marker(self, tag: str) -> None:  # zt-lint: disable=ZT04 — called from _flush_now/rollup_now, both under self.lock (same critical section as the state swap being recorded)
+        """Log a ZERO-lane WAL record marking an explicit flush/rollup.
+
+        The fused-step flush/rollup variants are replay-deterministic
+        (the host re-derives them from lane counts), but the EXPLICIT
+        paths — a percentile read's flush-then-read, the time-tier
+        sealer's pre-seal flush/rollup — are not: t-digest folding is
+        order-sensitive, so replay must re-apply them at the exact
+        stream position for the time-bucket digests to come back
+        bit-identical. Replay (tpu/wal.py) maps the marker back to
+        flush_now/rollup_now; wal_hook is None during replay, so
+        replayed markers never re-log."""
+        if self.wal_hook is not None and self.config.timetier_enabled:
+            self.wal_seq = self.wal_hook(
+                np.zeros((self.n_shards, 11, 0), np.uint32),
+                0, 0, 0, (0, 0), extra={tag: 1},
+            )
 
     def warm_programs(self, cols: SpanColumns) -> None:
         """Compile every program the steady-state ingest loop can
@@ -929,6 +1003,7 @@ class ShardedAggregator:
                 time.perf_counter() - t0
             ) * 1000.0
             self.write_version += 1
+            self._wal_marker("ttroll")
 
     def flush_now(self) -> None:
         """Public digest flush (compile warm-up, shutdown, tests)."""
@@ -982,6 +1057,30 @@ class ShardedAggregator:
             q, n = self._pull(packed)
             return q, n
 
+    def tt_read(self, lo_ep: int, hi_ep: int):
+        """(slot_epochs [W], hll_regs [S+1, m], digest [K, Cw, 2],
+        calls [S, S], errs [S, S]) for the bucket-epoch range
+        ``[lo_ep, hi_ep]``, merged across shards on device — ONE packed
+        pull (the tier's only device transfer per windowed query: the
+        unsealed-suffix read; sealed buckets merge host-side from
+        segments). A digest flush runs first so the bucket digests
+        include every pending point (same flush-then-read economics as
+        quantiles(); explicit-flush replay determinism is covered by the
+        ttflush WAL marker)."""
+        with self.lock:
+            if self._pend_lanes:
+                self._flush_now()
+            ep, regs, digest, calls, errs = self._pull(self._ttread(
+                self._link_context_cached(), self.state,
+                jnp.int32(lo_ep), jnp.int32(hi_ep),
+            ))
+            return ep, regs, digest, calls, errs
+
+    @property
+    def tt_max_epoch(self) -> int:
+        """Highest bucket epoch ingest has touched (-1: none yet)."""
+        return self._tt_max_epoch
+
     def cardinalities(self) -> np.ndarray:
         """[S+1] HLL distinct-trace estimates (last row global), computed
         on device — only the estimates cross the tunnel, not registers."""
@@ -1007,10 +1106,15 @@ class ShardedAggregator:
         replacing ``self.state`` wholesale, e.g. snapshot restore)."""
         with self.lock:
             # routed through the counted chokepoint: a restore-time pull
-            # is rare but should still show in the transfer ledger
-            self._pend_lanes = int(
-                readpack.device_get(self.state.pend_pos).max()
-            )
+            # is rare but should still show in the transfer ledger. ONE
+            # packed pull covers the pend mirror and (tier on) the
+            # restored current-bucket epochs — both i32 lanes.
+            lanes = [self.state.pend_pos.reshape(-1)]
+            if self.config.timetier_enabled:
+                lanes.append(self.state.tb_epoch.reshape(-1))
+            packed = readpack.device_get(jnp.concatenate(lanes))
+            n_pend = self.state.pend_pos.size
+            self._pend_lanes = int(packed[:n_pend].max())
             # write distance since the last rollup is not recorded in
             # state; assume the worst so the next batch rolls up first
             self._lanes_since_rollup = self.config.rollup_segment
@@ -1021,6 +1125,10 @@ class ShardedAggregator:
             self._resident.append(
                 (0, (1 << 32) - 1, self._shard_cursor.copy())
             )
+            if self.config.timetier_enabled:
+                # restored current-bucket epochs ARE recorded in state;
+                # the freshest one is the unsealed bucket after resume
+                self._tt_max_epoch = int(packed[n_pend:].max())
             self.write_version += 1
 
     def state_arrays(self) -> list:

@@ -283,6 +283,15 @@ class ZipkinServer:
                     # get, never intern: a read-side plane must not
                     # perturb the id streams it audits
                     svc_resolver=core.vocab.services.get,
+                    # windowed ground truth (ISSUE 15): bucket the
+                    # shadow's sub-streams at the time tier's epoch
+                    # granularity so the accuracy rollup can audit
+                    # sealed segments bucket-for-bucket
+                    bucket_minutes=(
+                        core.config.time_bucket_minutes
+                        if getattr(core, "timetier", None) is not None
+                        else 0
+                    ),
                 )
                 self._accuracy = AccuracyEstimator(
                     core,
@@ -321,6 +330,14 @@ class ZipkinServer:
             # when a publish costs more than a tick (slow device reads),
             # the duty-cycle cap leaves at least equal lock time free
             # between epochs for fresh reads and ingest.
+            # time-tier sealer on the same ticker, BEFORE the mirror
+            # publisher (ISSUE 15): each tick freezes finished device
+            # time buckets into host segments, so the epoch the
+            # publisher cuts next already serves demand-registered
+            # windowed ``ttq:`` keys from sealed segments (no aggregator
+            # lock in those computes).
+            if getattr(core, "timetier", None) is not None:
+                self._obs_windows.on_tick(lambda _w: core.tt_seal())
             if self._mirror is not None and self._mirror.enabled:
                 _mirror_core = getattr(
                     self.storage, "delegate", self.storage
@@ -943,10 +960,19 @@ class ZipkinServer:
     async def get_tpu_cardinalities(self, request: web.Request) -> web.Response:
         try:
             staleness = self._staleness_param(request)
+            # optional endTs/lookback (ms, the query-API convention)
+            # route to the time tier — windowed cardinalities over the
+            # covering bucket segments (HLL register-max merge)
+            end_ts = request.query.get("endTs")
+            lookback = request.query.get("lookback")
+            end_ts = int(end_ts) if end_ts is not None else None
+            lookback = int(lookback) if lookback is not None else None
         except ValueError as e:
             return web.Response(status=400, text=str(e))
         return web.json_response(
-            await asyncio.to_thread(self.storage.trace_cardinalities, staleness)
+            await asyncio.to_thread(
+                self.storage.trace_cardinalities, staleness, end_ts, lookback
+            )
         )
 
     async def get_tpu_counters(self, request: web.Request) -> web.Response:

@@ -356,6 +356,8 @@ _AGG_ENV_FIELDS = (
     "max_services", "max_keys", "hll_precision", "digest_centroids",
     "digest_buffer", "ring_capacity", "link_buckets", "bucket_minutes",
     "hist_slices", "hist_slice_minutes",
+    # time-disaggregated sketch tier (TPU_TIME_BUCKETS=0 disables)
+    "time_buckets", "time_bucket_minutes", "time_digest_centroids",
 )
 
 

@@ -74,9 +74,48 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
     # cond forced full copies of both pending buffers through the
     # conditional every step (~45% of step device time in the r2 profile
     # capture, PROFILE_r02.md) even when no flush ran.
-    pend_key, pend_val, pend_pos = _digest_append(
-        config, state, batch.key, batch.dur.astype(jnp.float32), has_dur
+    pend_key, pend_val, pend_pos, pend_ep = _digest_append(
+        config, state, batch.key, batch.dur.astype(jnp.float32), has_dur,
+        batch.ts_min,
     )
+
+    # --- time-disaggregated current-bucket leaves (tpu/timetier.py) -----
+    # Same epoch-ring recycle as the histogram slices, over bucket epochs
+    # of time_bucket_minutes: the HLL registers update here per step; the
+    # bucketed digest points ride the SAME pending buffer (pend_ep tags
+    # each point's bucket) and fold at flush; the edge counts fold at
+    # rollup cadence. config.time_buckets is trace-static, so the
+    # disabled tier compiles the exact pre-tier step.
+    tt = {}
+    if config.timetier_enabled:
+        w_tt = config.time_buckets
+        g = jnp.uint32(config.time_bucket_minutes)
+        ep_tt = (batch.ts_min // g).astype(jnp.int32)
+        sl_tt = ep_tt % w_tt
+        tb_epoch, tb_wipe, tb_keep = _recycle_slots(
+            w_tt, state.tb_epoch, sl_tt, ep_tt, valid
+        )
+        tb_hll = jnp.where(tb_wipe[:, None, None], jnp.uint8(0), state.tb_hll)
+        rows_flat = sl_tt * config.hll_rows + svc_rows
+        flat = tb_hll.reshape(w_tt * config.hll_rows, -1)
+        flat = _hll_update(flat, rows_flat, h, tb_keep & (batch.svc > 0))
+        flat = _hll_update(
+            flat, sl_tt * config.hll_rows + config.global_hll_row, h, tb_keep
+        )
+        tt = dict(
+            tb_epoch=tb_epoch,
+            tb_hll=flat.reshape(tb_hll.shape),
+            tb_digest=jnp.where(
+                tb_wipe[:, None, None, None], 0.0, state.tb_digest
+            ),
+            tb_calls=jnp.where(
+                tb_wipe[:, None, None], jnp.uint32(0), state.tb_calls
+            ),
+            tb_errs=jnp.where(
+                tb_wipe[:, None, None], jnp.uint32(0), state.tb_errs
+            ),
+            pend_ep=pend_ep,
+        )
 
     # --- ring append (valid lanes first, advance by live count) ---------
     order = jnp.argsort(~valid)  # stable: valid lanes keep order, pad sinks
@@ -148,6 +187,7 @@ def ingest_step(config: AggConfig, state: AggState, batch: SpanColumns) -> AggSt
         # never exceeds rollup_segment before the next ctx advance
         ctx_delta=state.ctx_delta + live,
         counters=counters,
+        **tt,
     )
     return new_state
 
@@ -217,7 +257,8 @@ def _flush_pending_digest(
     return tdigest.row_merge(digest, partial)
 
 
-def _digest_append(config: AggConfig, state: AggState, key, val, has_dur):
+def _digest_append(config: AggConfig, state: AggState, key, val, has_dur,
+                   ts_min=None):
     """Append the batch's (key, value) points to the pending ring.
 
     PRECONDITION (host-enforced, see ShardedAggregator.ingest): pend_pos +
@@ -227,18 +268,63 @@ def _digest_append(config: AggConfig, state: AggState, key, val, has_dur):
     pos = state.pend_pos
     pk = jax.lax.dynamic_update_slice(state.pend_key, batch_key, (pos,))
     pv = jax.lax.dynamic_update_slice(state.pend_val, val, (pos,))
-    return pk, pv, pos + key.shape[0]
+    pe = state.pend_ep
+    if config.timetier_enabled and ts_min is not None:
+        # bucket-epoch tag per point; validity is re-checked against
+        # tb_epoch at FLUSH time, so a slot recycled between append and
+        # flush drops its stale points (late-arrival semantics)
+        ep = (ts_min // jnp.uint32(config.time_bucket_minutes)).astype(
+            jnp.int32
+        )
+        pe = jax.lax.dynamic_update_slice(
+            pe, jnp.where(has_dur, ep, -1), (pos,)
+        )
+    return pk, pv, pos + key.shape[0], pe
+
+
+def _flush_pending_tt(config: AggConfig, tb_epoch, tb_digest, pend_key,
+                      pend_val, pend_ep):
+    """Fold the pending points into their bucket slots' compact digests:
+    one compact_points segmented by (bucket slot, key) over W*K rows,
+    then a row-parallel merge — the same split formulation as the
+    cumulative flush. Points whose bucket epoch no longer matches the
+    slot (recycled since append, or older than the ring) fold nowhere.
+    Per-slot segmentation keeps bucket contents independent of the other
+    epochs sharing the buffer — the property the windowed bit-identity
+    oracle (tests/test_timetier.py) rests on."""
+    w_tt = config.time_buckets
+    k = config.max_keys
+    cw = config.time_digest_centroids
+    sl = jnp.where(pend_ep >= 0, pend_ep % w_tt, 0)
+    live = (pend_ep >= 0) & (pend_key >= 0) & (tb_epoch[sl] == pend_ep)
+    w = live.astype(jnp.float32)
+    keys = jnp.clip(pend_key, 0, k - 1)
+    partial = tdigest.compact_points(
+        sl * k + keys, pend_val, w, w_tt * k, cw
+    )
+    merged = tdigest.row_merge(tb_digest.reshape(w_tt * k, cw, 2), partial)
+    return merged.reshape(w_tt, k, cw, 2)
 
 
 def flush_digest(config: AggConfig, state: AggState) -> AggState:
     """Reader-side flush: fold any pending values so digest reads are
     complete. Pure; call via jit before quantile queries."""
     d = _flush_pending_digest(config, state.digest, state.pend_key, state.pend_val)
+    tt = {}
+    if config.timetier_enabled:
+        tt = dict(
+            tb_digest=_flush_pending_tt(
+                config, state.tb_epoch, state.tb_digest,
+                state.pend_key, state.pend_val, state.pend_ep,
+            ),
+            pend_ep=jnp.full_like(state.pend_ep, -1),
+        )
     return state._replace(
         digest=d,
         pend_key=jnp.full_like(state.pend_key, -1),
         pend_val=jnp.zeros_like(state.pend_val),
         pend_pos=jnp.zeros_like(state.pend_pos),
+        **tt,
     )
 
 
@@ -323,10 +409,30 @@ def rollup_step(config: AggConfig, state: AggState) -> AggState:
     )
     rollup_calls = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_calls)
     rollup_errs = jnp.where(wipe[:, None, None], jnp.uint32(0), state.rollup_errs)
+    # time-tier edge fold: the SAME resolve emits a second bucketed pass
+    # at time_bucket_minutes granularity into the current-bucket edge
+    # planes. Slot recycle for these lives in the ingest step (shared
+    # tb_epoch); a lane whose bucket epoch is no longer current in its
+    # slot emits nowhere (late-arrival semantics).
+    tt = {}
+    if config.timetier_enabled:
+        w_tt = config.time_buckets
+        g = jnp.uint32(config.time_bucket_minutes)
+        ep_tt = (state.r_ts_min // g).astype(jnp.int32)
+        sl_tt = ep_tt % w_tt
+        emit_tt = to_roll & (state.tb_epoch[sl_tt] == ep_tt)
+        calls_tt, errs_tt = linker.emit_links_bucketed(
+            ctx, sl_tt, w_tt, emit_tt, config.max_services
+        )
+        tt = dict(
+            tb_calls=state.tb_calls + calls_tt,
+            tb_errs=state.tb_errs + errs_tt,
+        )
     return state._replace(
         rollup_calls=rollup_calls + calls_d,
         rollup_errs=rollup_errs + errs_d,
         rollup_epoch=new_epoch,
+        **tt,
         # rolled lanes stop emitting but stay join-visible (r_valid keeps
         # them in the parent table until the cursor overwrites them) — so
         # a live child written shortly after its parent rolled still
@@ -411,6 +517,55 @@ def key_quantiles_digest(state: AggState, qs: jnp.ndarray) -> jnp.ndarray:
 def cardinalities(state: AggState) -> jnp.ndarray:
     """[services+1] estimated distinct traces (last row = global)."""
     return hll.estimate(state.hll)
+
+
+def tt_sketches(
+    config: AggConfig,
+    state: AggState,
+    lo_ep: jnp.ndarray,
+    hi_ep: jnp.ndarray,
+    ctx: linker.LinkContext = None,
+):
+    """Read the time-tier slots whose bucket epoch falls in
+    ``[lo_ep, hi_ep]`` as ONE mergeable per-shard part:
+
+    - ``epoch`` [W] i32: the slot epochs (host computes actual coverage),
+    - ``regs``  [S+1, m] u8: register-max over selected slots,
+    - ``digest`` [K, Cw, 2] f32: row-parallel recluster of the selected
+      slots' compact digests (one row_merge over the W*Cw concat, the
+      merge_many idiom),
+    - ``calls``/``errs`` [S, S] u32: the same live-ring + rolled split
+      as :func:`dependency_links`, at bucket granularity — un-rolled
+      ring lanes whose bucket epoch falls in the range emit through
+      ``ctx`` (pass the cached one to skip the ring-sort half), rolled
+      lanes come from the ``tb_calls``/``tb_errs`` planes. Every lane
+      is in exactly one of the two, so the split is exact.
+
+    The sealer calls this with lo==hi (one bucket -> one segment); the
+    windowed query path calls it for the unsealed suffix. The tier's
+    query side never touches archive scans (lint rule ZT07 fences it)."""
+    sel = _slots_in_window(state.tb_epoch, lo_ep, hi_ep)
+    regs = jnp.max(
+        jnp.where(sel[:, None, None], state.tb_hll, jnp.uint8(0)), axis=0
+    )
+    d = state.tb_digest  # [W, K, Cw, 2]
+    w_tt, k, cw, _ = d.shape
+    dm = jnp.stack(
+        [d[..., 0], jnp.where(sel[:, None, None], d[..., 1], 0.0)], axis=-1
+    )
+    all_c = jnp.moveaxis(dm, 0, 1).reshape(k, w_tt * cw, 2)
+    digest = tdigest.row_merge(jnp.zeros((k, cw, 2), jnp.float32), all_c)
+    if ctx is None:
+        ctx = fresh_link_context(config, state)
+    g = jnp.uint32(config.time_bucket_minutes)
+    ep_lane = (state.r_ts_min // g).astype(jnp.int32)
+    in_w = (ep_lane >= lo_ep) & (ep_lane <= hi_ep)
+    live_c, live_e = linker.emit_links(
+        ctx, state.r_valid & ~state.r_rolled & in_w, config.max_services
+    )
+    calls = live_c + _masked_slot_sum(sel, state.tb_calls)
+    errs = live_e + _masked_slot_sum(sel, state.tb_errs)
+    return state.tb_epoch, regs, digest, calls, errs
 
 
 @functools.lru_cache(maxsize=None)

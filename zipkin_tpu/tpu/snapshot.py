@@ -94,7 +94,10 @@ DEFAULT_KEEP_GENERATIONS = 2
 #      order/keys/runs/safe-candidates + resolved tree + watermark
 #      cursor) — resumed ctx must be bit-identical, so it rides the
 #      snapshot like every other leaf.
-SNAPSHOT_VERSION = 4
+# v5 = time-disaggregated sketch tier (tb_* current-bucket leaves +
+#      pend_ep bucket tags, time_buckets/time_bucket_minutes/
+#      time_digest_centroids config keys) — tpu/timetier.py.
+SNAPSHOT_VERSION = 5
 
 
 def _fsync_dir(directory: str) -> None:

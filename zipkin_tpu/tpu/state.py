@@ -81,6 +81,20 @@ class AggConfig:
     # published link count is below this is "rare" and always kept.
     sampling: bool = False
     sample_rare_min: int = 4
+    # time-disaggregated sketch tier (tpu/timetier.py): the ingest step
+    # ALSO updates a current-bucket set of sketch leaves — tb_hll /
+    # tb_digest / tb_calls+tb_errs over W = time_buckets ring slots of
+    # time_bucket_minutes each (slot = epoch % W, recycled exactly like
+    # hist_t slices). A host-side sealer reads completed buckets out as
+    # compact mergeable segments; queries over [lookback, endTs] merge
+    # covering segments plus the unsealed device slots. The persisted
+    # query digest is deliberately SMALLER than the cumulative update
+    # digest (the SF-sketch two-stage split): time_digest_centroids
+    # clusters per key per bucket. time_buckets=0 disables the tier
+    # (no leaves allocated, no tt programs compiled).
+    time_buckets: int = 4
+    time_bucket_minutes: int = 5
+    time_digest_centroids: int = 32
 
     def __post_init__(self) -> None:
         # the packed wire image gives service ids 16 bits and sketch keys
@@ -106,6 +120,10 @@ class AggConfig:
     @property
     def global_hll_row(self) -> int:
         return self.max_services
+
+    @property
+    def timetier_enabled(self) -> bool:
+        return self.time_buckets > 0
 
     @property
     def rollup_segment(self) -> int:
@@ -153,6 +171,20 @@ class AggState(NamedTuple):
     rollup_calls: jnp.ndarray  # u32 [D, S, S]
     rollup_errs: jnp.ndarray  # u32 [D, S, S]
     rollup_epoch: jnp.ndarray  # i32 [D] — absolute bucket held, -1 empty
+    # time-disaggregated sketch tier (current-bucket leaves): W ring
+    # slots of time_bucket_minutes each; slot = bucket_epoch % W,
+    # recycled on a newer epoch exactly like hist_t slices. tb_epoch is
+    # the ONE shared epoch array — a recycle wipes every tt plane for
+    # the slot. tb_digest holds the compact per-key query digest
+    # (time_digest_centroids clusters); pend_ep tags each pending digest
+    # point with its bucket epoch so the flush can fold points into
+    # their bucket slots segmented by (slot, key).
+    tb_epoch: jnp.ndarray  # i32 [W] — absolute bucket epoch held, -1 empty
+    tb_hll: jnp.ndarray  # u8 [W, services+1, m]
+    tb_digest: jnp.ndarray  # f32 [W, keys, Cw, 2]
+    tb_calls: jnp.ndarray  # u32 [W, S, S]
+    tb_errs: jnp.ndarray  # u32 [W, S, S]
+    pend_ep: jnp.ndarray  # i32 [P] — bucket epoch per pending point, -1 empty
     # published tail-sampling tables (zipkin_tpu/sampling). These are
     # HOST-AUTHORITATIVE: the controller computes them on host and
     # publishes by swapping the leaves under the aggregator lock; the
@@ -219,6 +251,33 @@ def init_state(config: AggConfig) -> AggState:
             jnp.uint32,
         ),
         rollup_epoch=jnp.full((config.link_buckets,), -1, jnp.int32),
+        tb_epoch=jnp.full((config.time_buckets,), -1, jnp.int32),
+        tb_hll=jnp.zeros(
+            (config.time_buckets, config.hll_rows, 1 << config.hll_precision),
+            jnp.uint8,
+        ),
+        tb_digest=jnp.zeros(
+            (
+                config.time_buckets,
+                config.max_keys,
+                config.time_digest_centroids,
+                2,
+            ),
+            jnp.float32,
+        ),
+        tb_calls=jnp.zeros(
+            (config.time_buckets, config.max_services, config.max_services),
+            jnp.uint32,
+        ),
+        tb_errs=jnp.zeros(
+            (config.time_buckets, config.max_services, config.max_services),
+            jnp.uint32,
+        ),
+        pend_ep=jnp.full(
+            (config.digest_buffer if config.time_buckets else 0,),
+            -1,
+            jnp.int32,
+        ),
         # sampler tables boot in "keep everything" posture: max rate, an
         # unreachable tail threshold, and zero published link counts
         # (every edge rare). The controller publishes real tables later.

@@ -33,7 +33,7 @@ import numpy as np
 from zipkin_tpu import obs, readpack
 from zipkin_tpu.internal.hex import epoch_minutes
 from zipkin_tpu.obs import querytrace
-from zipkin_tpu.ops import hll
+from zipkin_tpu.ops import hll, ttmerge
 from zipkin_tpu.model.span import DependencyLink, Span
 from zipkin_tpu.storage.memory import InMemoryStorage
 from zipkin_tpu.storage.spi import (
@@ -310,6 +310,24 @@ class TpuStorage(
         # lazily for the same reason the querytrace lock provider does.
         self.mirror = ReadMirror(lambda: getattr(self, "agg", None))
         self._seed_mirror()
+        # time-disaggregated sketch tier (tpu/timetier.py, ISSUE 15):
+        # a ticker-driven sealer freezes finished device time buckets
+        # into host-side mergeable segments; windowed [lookback, endTs]
+        # quantile/cardinality/dependency reads then merge the covering
+        # segments in numpy, with at most one device pull for the
+        # unsealed current bucket. Segments persist under the archive
+        # dir (when configured) so old windows survive restarts.
+        self.timetier = None
+        if self.config.timetier_enabled:
+            from zipkin_tpu.tpu.timetier import TimeTier
+
+            self.timetier = TimeTier(
+                self.config,
+                directory=(
+                    _os.path.join(archive_dir, "timetier")
+                    if archive_dir else None
+                ),
+            )
         # archive-only restart: segment columns store vocab IDS, so the
         # ids must survive the process or every recovered segment becomes
         # unsearchable. A snapshot restore (storage/tpu.py) replaces the
@@ -894,7 +912,7 @@ class TpuStorage(
         lo_min = epoch_minutes(request.end_ts - request.lookback)
         hi_min = epoch_minutes(request.end_ts)
 
-        def fetch(cand_limit: int) -> Tuple[List[List[Span]], bool]:
+        def scan_candidates(cand_limit: int) -> Tuple[List[List[Span]], bool]:
             # ONE view snapshot for the whole query: the live segment
             # sorts its rows when a view is taken, so per-trace
             # re-snapshots would re-sort per candidate
@@ -965,11 +983,11 @@ class TpuStorage(
             )
             return out[: request.limit], len(cands) >= cand_limit
 
-        results, capped = fetch(request.limit * 4 + 16)
+        results, capped = scan_candidates(request.limit * 4 + 16)
         if capped and len(results) < request.limit:
             # the post-filter starved the limit inside the first scan
             # window: widen once before settling for fewer results
-            results, _ = fetch((request.limit * 4 + 16) * 8)
+            results, _ = scan_candidates((request.limit * 4 + 16) * 8)
         return results
 
     def get_service_names(self) -> Call[List[str]]:
@@ -1217,6 +1235,74 @@ class TpuStorage(
             self.mirror.register(key, compute)
         return self._cached_read(key, compute)
 
+    # -- time-disaggregated sketch tier (tpu/timetier.py, ISSUE 15) ------
+
+    def tt_seal(self, limit: Optional[int] = None) -> int:
+        """Ticker seam: seal every finished device time bucket into the
+        host time tier (the windows ticker calls this each tick, next
+        to publish_mirror). Returns segments sealed; 0 when the tier is
+        disabled (``time_buckets=0``) or nothing is due."""
+        if self.timetier is None:
+            return 0
+        return self.timetier.seal_up_to(self.agg, limit=limit)
+
+    def _tt_epochs(self, end_ts: int, lookback: Optional[int]):
+        """Bucket-aligned epoch range for a windowed sketch read — the
+        mirror-key canonicalization: every (endTs, lookback) pair whose
+        endpoints land in the same time buckets maps to the same
+        (lo_ep, hi_ep), so a polling client stepping endTs by seconds
+        reuses ONE ``ttq:`` demand key instead of registering a fresh
+        key (and a fresh publish-time merge) per request."""
+        g = self.config.time_bucket_minutes
+        lb = lookback if lookback is not None else end_ts
+        lo_ep = max(0, epoch_minutes(end_ts - lb) // g)
+        hi_ep = max(0, epoch_minutes(end_ts) // g)
+        return lo_ep, hi_ep
+
+    def _tt_window(self, lo_ep: int, hi_ep: int, staleness_ms=None):
+        """Mirror-first windowed sketch read: ONE demand key per
+        bucket-aligned epoch range (``ttq:<lo_ep>:<hi_ep>``) carrying
+        the merged WindowAnswer for all three windowed routes
+        (quantiles, cardinalities, dependencies). A sealed-only
+        window's compute never touches the aggregator lock; a range
+        reaching past ``sealed_through`` re-enters it only for the one
+        packed device pull of the unsealed current bucket."""
+        key = f"ttq:{lo_ep}:{hi_ep}"
+        return self._mirror_read(
+            key,
+            # lambda derefs self.agg/self.timetier at CALL time
+            # (clear() swaps the aggregator wholesale)
+            lambda: self.timetier.window(self.agg, lo_ep, hi_ep),
+            staleness_ms,
+        )
+
+    def _tt_dependency_links(self, ans) -> List[DependencyLink]:
+        """Shape a merged WindowAnswer's dense edge planes into API
+        links (the dense-pull shaping from _dependency_links)."""
+        t0_ns = time.perf_counter_ns()
+        s = self.config.max_services
+        dense_c = np.asarray(ans.calls)
+        dense_e = np.asarray(ans.errs)
+        p_idx, c_idx = np.nonzero(dense_c)
+        out: List[DependencyLink] = []
+        for p, c in zip(p_idx, c_idx):
+            parent = self.vocab.services.lookup(int(p))
+            child = self.vocab.services.lookup(int(c))
+            if not parent or not child:
+                continue
+            out.append(
+                DependencyLink(
+                    parent=parent,
+                    child=child,
+                    call_count=int(dense_c[p, c]),
+                    error_count=int(dense_e[p, c]),
+                )
+            )
+        querytrace.stamp_active(
+            querytrace.QSEG_LINK_RESOLVE, t0_ns, time.perf_counter_ns()
+        )
+        return out
+
     def get_dependencies(
         self, end_ts: int, lookback: int,
         staleness_ms: Optional[float] = None,
@@ -1234,6 +1320,18 @@ class TpuStorage(
         self, end_ts: int, lookback: int,
         staleness_ms: Optional[float] = None,
     ) -> List[DependencyLink]:
+            tt = self.timetier
+            if tt is not None:
+                lo_ep, hi_ep = self._tt_epochs(end_ts, lookback)
+                if lo_ep <= tt.sealed_through:
+                    # time-tier route (ISSUE 15): some of the window is
+                    # already sealed — merge the covering segments
+                    # host-side (exact per-bucket edge counts, verified
+                    # bit-equal to the dense ring pull) instead of
+                    # re-sorting the span ring; windows the sealer has
+                    # not reached yet stay on the ring path below
+                    ans = self._tt_window(lo_ep, hi_ep, staleness_ms)
+                    return self._tt_dependency_links(ans)
             lo_min = epoch_minutes(end_ts - lookback)
             hi_min = epoch_minutes(end_ts)
             # mirror-first: the published epoch carries the final link
@@ -1369,10 +1467,13 @@ class TpuStorage(
         Lens duration-percentile context needs, served from sketches.
 
         With ``end_ts``/``lookback`` (epoch ms, as in the query API) the
-        rows come from the time-sliced histograms — windowed percentiles,
-        covering the most recent T*slice_minutes of traffic (older
-        windows return no rows; the all-time path has no window).
-        Returns dicts: {service, spanName, count, quantiles: {q: µs}}.
+        rows come from the time tier when its sealer has reached the
+        window (per-bucket t-digests merged host-side over the covering
+        sealed segments — ARBITRARY ranges, ISSUE 15), else from the
+        time-sliced histograms covering the most recent
+        T*slice_minutes of traffic (``use_digest=False`` forces the
+        hist-slice path). Returns dicts: {service, spanName, count,
+        quantiles: {q: µs}}.
 
         ``staleness_ms`` tunes the mirror-first serve: None accepts the
         mirror's published bound, a positive value tightens/loosens it
@@ -1387,16 +1488,35 @@ class TpuStorage(
                 end_ts = int(time.time() * 1000)
             qkey = ",".join(f"{q:.6g}" for q in qs)
             if end_ts is not None:
-                lb = lookback if lookback is not None else end_ts
-                lo_min = epoch_minutes(end_ts - lb)
-                hi_min = epoch_minutes(end_ts)
-                source_q, counts = self._mirror_read(
-                    f"quant:w:{lo_min}:{hi_min}:{qkey}",
-                    lambda: self.agg.quantiles(
-                        qs, ts_lo_min=lo_min, ts_hi_min=hi_min
-                    ),
-                    staleness_ms,
+                tt = self.timetier
+                lo_ep, hi_ep = (
+                    self._tt_epochs(end_ts, lookback)
+                    if tt is not None else (0, -1)
                 )
+                if (
+                    use_digest and tt is not None
+                    and lo_ep <= tt.sealed_through
+                ):
+                    # time-tier route (ISSUE 15): per-bucket t-digests
+                    # merged host-side over the covering sealed
+                    # segments (ops/ttmerge.py) — arbitrary [lookback,
+                    # endTs] ranges, not just the hist-slice horizon;
+                    # the unsealed current bucket is the one device
+                    # pull when the range reaches it
+                    ans = self._tt_window(lo_ep, hi_ep, staleness_ms)
+                    source_q = ttmerge.digest_quantile(ans.digest, qs)
+                    counts = ttmerge.digest_total(ans.digest)
+                else:
+                    lb = lookback if lookback is not None else end_ts
+                    lo_min = epoch_minutes(end_ts - lb)
+                    hi_min = epoch_minutes(end_ts)
+                    source_q, counts = self._mirror_read(
+                        f"quant:w:{lo_min}:{hi_min}:{qkey}",
+                        lambda: self.agg.quantiles(
+                            qs, ts_lo_min=lo_min, ts_hi_min=hi_min
+                        ),
+                        staleness_ms,
+                    )
             else:
                 src = "digest" if use_digest else "hist"
                 source_q, counts = self._mirror_read(
@@ -1496,11 +1616,27 @@ class TpuStorage(
         return out
 
     def trace_cardinalities(
-        self, staleness_ms: Optional[float] = None
+        self, staleness_ms: Optional[float] = None,
+        end_ts: Optional[int] = None,
+        lookback: Optional[int] = None,
     ) -> dict:
-        """Estimated distinct trace counts: {"_global": n, service: n, ...}."""
+        """Estimated distinct trace counts: {"_global": n, service: n, ...}.
+
+        With ``end_ts``/``lookback`` (epoch ms) the registers come from
+        the time tier's covering bucket segments (HLL register-max
+        merge, ops/ttmerge.py) — windowed cardinality over arbitrary
+        ranges; without a window the all-time cumulative registers
+        serve, as before."""
         qt = self.querytrace.begin("cardinalities")
         try:
+            if end_ts is None and lookback is not None:
+                # endTs defaults to "now" when only lookback is given
+                # (QueryRequest semantics, SURVEY.md §2.3)
+                end_ts = int(time.time() * 1000)
+            if end_ts is not None and self.timetier is not None:
+                lo_ep, hi_ep = self._tt_epochs(end_ts, lookback)
+                ans = self._tt_window(lo_ep, hi_ep, staleness_ms)
+                return self._cardinality_rows(ttmerge.hll_estimate(ans.hll))
             # lambda, not the bound method: a registered demand closure
             # must deref self.agg at CALL time (clear() swaps it)
             est = self._mirror_read(
@@ -1628,6 +1764,14 @@ class TpuStorage(
             # gauges — mirrorServeAgeMs backs the query_mirror_staleness
             # SLO and the zipkin_tpu_mirror_* prometheus families
             **self.mirror.counters(),
+            # time-disaggregated sketch tier (ttSeals / ttSegments* /
+            # ttWindowReads / ttMissingEpochs ...): seal cadence, ring
+            # occupancy, and windowed-read merge cost
+            **(
+                self.timetier.export_counters()
+                if self.timetier is not None
+                else {}
+            ),
         }
 
     def set_query_observatory(self, on: bool) -> None:
@@ -1684,6 +1828,10 @@ class TpuStorage(
 
         self._archive.clear()
         self.agg = ShardedAggregator(self.config, mesh=self.agg.mesh)
+        # sealed segments were cut from the old aggregator's buckets —
+        # a windowed read must not merge them with the new one's
+        if self.timetier is not None:
+            self.timetier.clear()
         # the swap replaced the aggregator: the published mirror epoch
         # was cut against versions that no longer compare — drop it
         # (demand keys survive; the next publish refills)

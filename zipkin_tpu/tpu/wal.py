@@ -482,6 +482,19 @@ def replay(store, wal: WriteAheadLog, from_seq: int = 0) -> int:
                 # batches the live run published between — later replayed
                 # verdicts must read the post-publish tables
                 store.apply_sctl(sctl)
+            if meta.get("ttflush"):
+                # explicit digest flush marker (percentile reads, the
+                # time-tier sealer): t-digest folding is order-sensitive,
+                # so replay re-applies the flush at the exact stream
+                # position — the time-bucket digests (tb_digest) come
+                # back bit-identical only if pending points fold in the
+                # same groups as the live run. wal_hook is None here, so
+                # the replayed flush never re-logs its own marker.
+                agg.flush_now()
+            if meta.get("ttroll"):
+                # explicit rollup marker (the sealer's pre-seal rollup):
+                # same exact-position rule for the rolled edge planes
+                agg.rollup_now()
             if fused.shape[-1]:
                 agg.ingest_fused(
                     np.array(fused),  # frombuffer view is read-only
