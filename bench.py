@@ -99,9 +99,11 @@ def main() -> None:
     # boundary leg (ISSUE 6 — benchmarks/obs_overhead.py owns it);
     # "scrub": background at-rest scrubber on/off A/B over a durable
     # store (ISSUE 7 — benchmarks/scrub_overhead.py owns it);
-    # "fanout": wire-to-ack matrix over the parse fan-out tier —
-    # workers x format x transport with per-stage decomposition and the
-    # 429 onset probe (benchmarks/ingest_fanout.py owns it, INGEST_r07);
+    # "fanout": wire-to-ack matrix over the span-ring fan-out tier —
+    # workers x coalesce-depth x format x transport with the per-stage
+    # decomposition, the ring-vs-queue A/B (coalesce=1 leg vs the
+    # recorded INGEST_r08 per-worker-queue baseline), and the 429 onset
+    # probe (benchmarks/ingest_fanout.py owns it, INGEST_r09);
     # "query_concurrency": the query-SLO harness with the >=8-thread
     # concurrent-read leg — queries/sec, p99, and the lock_wait vs
     # device vs transfer split from the query-plane observatory

@@ -1,21 +1,30 @@
 """Fan-out tier benchmark: wire-to-ack spans/s across the full matrix
-(INGEST_r08 artifact; BENCH_MODE=fanout in bench.py).
+(INGEST_r09 artifact; BENCH_MODE=fanout in bench.py).
 
-Measures what the ingest fan-out PR claims: sustained spans/s from wire
-bytes to ack through the REAL server boundary, as a function of
+Measures what the ingest fan-out + span-ring PRs claim: sustained
+spans/s from wire bytes to ack through the REAL server boundary, as a
+function of
 
 - parse workers (INGEST_FANOUT_WORKERS, default ``1,2,4``),
+- coalesce depth (INGEST_FANOUT_COALESCE, default ``1,8``): chunks one
+  dispatcher flush merges into a single remap + jitted step + WAL
+  record. The ``coalesce=1`` leg is per-chunk dispatch granularity —
+  the ring-vs-queue A/B against the recorded per-worker-queue baseline
+  (INGEST_r08.json, same matrix minus this axis) — and the deeper legs
+  show what amortizing the per-chunk dispatch tax buys (INGEST_r08
+  measured it at a 77.6% queue-wait share of wire-to-durable),
 - wire format (JSON v2 / proto3),
 - transport (HTTP POST /api/v2/spans vs gRPC SpanService/Report —
   gRPC carries proto3 only, so the json x grpc cell is skipped),
 
 plus a per-stage µs/span decomposition from the obs flight recorder
 (snapshot delta across each leg: boundary / parse / pack / route /
-mp_record and its shm-copy/vocab-replay/LUT-remap/device-feed
+mp_record and its shm-copy/vocab-replay/LUT-remap/coalesce/device-feed
 substages), a per-cell **critpath report** from the interval-ledger
-stitcher (exact wire-to-durable p50/p99, queue-wait vs service split,
-Little's-law gauges, conservation), and a 429-backpressure onset probe
-showing exactly when the bounded per-worker queues start pushing back.
+stitcher (exact wire-to-durable p50/p99, queue-wait vs service split
+incl. the new ring_wait segment, Little's-law gauges, conservation),
+and a 429-backpressure onset probe showing exactly when ring occupancy
+/ the bounded per-worker queues start pushing back.
 
 Throughput legs retry on 429/RESOURCE_EXHAUSTED with backoff (the
 documented client contract) and the drain tail counts toward elapsed —
@@ -26,7 +35,7 @@ claim is the multi-core EVALS config (evals/run_configs.py fanout).
 
 Run: ``BENCH_MODE=fanout python bench.py`` or
 ``python -m benchmarks.ingest_fanout``. Writes INGEST_FANOUT_OUT
-(default INGEST_r08.json) and prints the same JSON on stdout.
+(default INGEST_r09.json) and prints the same JSON on stdout.
 """
 
 from __future__ import annotations
@@ -46,7 +55,7 @@ def _stage_delta(snap0, snap1, accepted: int) -> dict:
     for st in (
         "http_boundary", "grpc_boundary", "parse", "pack", "route",
         "mp_record", "mp_shm_copy", "mp_vocab_replay", "mp_lut_remap",
-        "mp_device_feed", "device_dispatch", "wal_append",
+        "coalesce", "mp_device_feed", "device_dispatch", "wal_append",
     ):
         d_sum = snap1.stage(st).sum_us - snap0.stage(st).sum_us
         d_count = snap1.stage(st).count - snap0.stage(st).count
@@ -56,8 +65,8 @@ def _stage_delta(snap0, snap1, accepted: int) -> dict:
 
 
 async def _leg(
-    transport: str, fmt: str, workers: int, payloads, batch: int,
-    total: int, port: int,
+    transport: str, fmt: str, workers: int, coalesce: int, payloads,
+    batch: int, total: int, port: int,
 ) -> dict:
     from zipkin_tpu import obs
     from zipkin_tpu.server.app import ZipkinServer
@@ -69,6 +78,7 @@ async def _leg(
         ServerConfig(
             port=port, host="127.0.0.1", storage_type="tpu",
             tpu_fast_ingest=True, tpu_mp_workers=workers,
+            tpu_mp_coalesce_max=coalesce,
             grpc_collector_enabled=(transport == "grpc"), grpc_port=0,
         ),
         storage=storage,
@@ -105,14 +115,28 @@ async def _leg(
             "littles_law": wf["littlesLaw"],
             "segments": wf["segments"],
         }
+    coalesced = (
+        dict(
+            batches=ing.counters["coalescedBatches"],
+            chunks=ing.counters["coalescedChunks"],
+        )
+        if ing is not None
+        else None
+    )
     await server.stop()
+    qws = (
+        (critpath or {}).get("queue_wait_vs_service") or {}
+    ).get("waitFraction")
     return {
         "transport": transport,
         "format": fmt,
         "workers": workers,
+        "coalesce_max": coalesce,
         "spans_per_sec": round(accepted / elapsed, 1),
         "spans": accepted,
         "backpressure_429": stats["backpressure"],
+        "queue_wait_share": qws,
+        "coalesced": coalesced,
         "stage_us_per_span": _stage_delta(snap0, snap1, accepted),
         "critpath": critpath,
     }
@@ -169,6 +193,11 @@ async def run() -> dict:
         for w in os.environ.get("INGEST_FANOUT_WORKERS", "1,2,4").split(",")
         if w.strip()
     ]
+    coalesce_axis = [
+        int(c)
+        for c in os.environ.get("INGEST_FANOUT_COALESCE", "1,8").split(",")
+        if c.strip()
+    ]
     port = int(os.environ.get("INGEST_FANOUT_PORT", 19519))
 
     spans = lots_of_spans(2 * batch, seed=7, services=40, span_names=120)
@@ -190,23 +219,51 @@ async def run() -> dict:
             if transport == "grpc" and fmt == "json":
                 continue  # SpanService/Report is proto3-only by contract
             for w in workers_axis:
-                cell = await _leg(
-                    transport, fmt, w, payloads[fmt], batch, total,
-                    port + i,
-                )
-                i += 1
-                cells.append(cell)
-                cp = cell["critpath"] or {}
-                w2d = (cp.get("wire_to_durable_us") or {}).get("p99Us", 0)
-                print(
-                    f"{transport:<5} {fmt:<7} w={cell['workers']}"
-                    f" {cell['spans_per_sec']:>12,.0f} spans/s"
-                    f"  429s={cell['backpressure_429']}"
-                    f"  w2d_p99={w2d}us",
-                    file=sys.stderr,
-                )
+                for cx in coalesce_axis:
+                    cell = await _leg(
+                        transport, fmt, w, cx, payloads[fmt], batch,
+                        total, port + i,
+                    )
+                    i += 1
+                    cells.append(cell)
+                    cp = cell["critpath"] or {}
+                    w2d = (cp.get("wire_to_durable_us") or {}).get(
+                        "p99Us", 0
+                    )
+                    print(
+                        f"{transport:<5} {fmt:<7} w={cell['workers']}"
+                        f" cx={cell['coalesce_max']}"
+                        f" {cell['spans_per_sec']:>12,.0f} spans/s"
+                        f"  429s={cell['backpressure_429']}"
+                        f"  qwait={cell['queue_wait_share']}"
+                        f"  w2d_p99={w2d}us",
+                        file=sys.stderr,
+                    )
     onset = _onset_probe(payloads["proto3"], batch)
     best = max(cells, key=lambda c: c["spans_per_sec"])
+    # the ring-vs-queue A/B: best per-chunk (coalesce=1) ring cell
+    # against the recorded per-worker-queue baseline (INGEST_r08.json)
+    ring_ab = None
+    per_chunk = [c for c in cells if c["coalesce_max"] == 1]
+    if per_chunk:
+        b1 = max(per_chunk, key=lambda c: c["spans_per_sec"])
+        ring_ab = {
+            "ring_per_chunk_spans_per_sec": b1["spans_per_sec"],
+            "queue_baseline_artifact": "INGEST_r08.json",
+        }
+        try:
+            with open("INGEST_r08.json") as f:
+                r08 = json.load(f)
+            base = r08["best"]["spans_per_sec"]
+            ring_ab["queue_baseline_spans_per_sec"] = base
+            ring_ab["ring_vs_queue"] = round(
+                b1["spans_per_sec"] / base, 3
+            )
+            ring_ab["best_vs_queue"] = round(
+                best["spans_per_sec"] / base, 3
+            )
+        except (OSError, KeyError, ValueError):
+            pass
     return {
         "artifact": "ingest_fanout",
         "metric": "wire_to_ack_spans_per_sec",
@@ -215,16 +272,20 @@ async def run() -> dict:
         "cores": os.cpu_count(),
         "cells": cells,
         "backpressure_onset": onset,
+        "ring_vs_queue_ab": ring_ab,
         "best": {
             k: best[k]
-            for k in ("transport", "format", "workers", "spans_per_sec")
+            for k in (
+                "transport", "format", "workers", "coalesce_max",
+                "spans_per_sec", "queue_wait_share",
+            )
         },
     }
 
 
 def main() -> None:
     result = asyncio.run(run())
-    out = os.environ.get("INGEST_FANOUT_OUT", "INGEST_r08.json")
+    out = os.environ.get("INGEST_FANOUT_OUT", "INGEST_r09.json")
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")

@@ -154,3 +154,139 @@ def test_wal_append_crash_resume_with_workers_live(tmp_path):
     assert revived2.agg.host_counters == counters
     revived2.close()
     oracle.close()
+
+
+def test_workers1_coalesce1_matches_pre_ring_path(tmp_path):
+    """coalesce_max=1 is the pre-ring contract: per-chunk dispatch, one
+    WAL record per chunk, zero coalesced groups — so the ring handoff
+    alone must not perturb a single byte of the workers=1 bit-parity
+    claim (same WAL stream, same vocab order, same arrays)."""
+    ps = payloads(n_payloads=3)
+    sync = make_wal(tmp_path / "sync")
+    for p in ps:
+        assert sync.ingest_json_fast(p) is not None
+    mp_store = make_wal(tmp_path / "mp")
+    ing = MultiProcessIngester(mp_store, workers=1, coalesce_max=1)
+    try:
+        for p in ps:
+            ing.submit(p)
+        ing.drain()
+    finally:
+        ing.close()
+    assert ing.counters["coalescedBatches"] == 0
+    assert ing.counters["coalescedChunks"] == 0
+    assert_state_parity(sync, mp_store, exact_digest=True)
+    sync.close()
+    mp_store.close()
+    r_sync = make_wal(tmp_path / "sync")
+    r_mp = make_wal(tmp_path / "mp")
+    assert_query_parity(r_sync, r_mp)
+    assert r_sync.vocab.services._names == r_mp.vocab.services._names
+    r_sync.close()
+    r_mp.close()
+
+
+@pytest.mark.slow
+def test_coalesced_semantic_parity_and_replay_identity(tmp_path):
+    """coalesce_max>1 merges every multi-chunk payload's buffered chunks
+    into one device step + one WAL record. The sketch planes and
+    sampling outcome must stay semantically identical to the serial
+    path (batch COUNT diverges by design), and a WAL replay of the
+    coalesced records must reconstruct the live store bit for bit."""
+    # max_batch under this config is 4096, so 5120 spans = 2 chunks per
+    # payload, and each payload's chunk pair fits the 4096-lane cap
+    ps = payloads(n_payloads=3, spans_each=5120)
+    sync = make_wal(tmp_path / "sync")
+    for p in ps:
+        assert sync.ingest_json_fast(p) is not None
+    mp_store = make_wal(tmp_path / "mp")
+    ing = MultiProcessIngester(
+        mp_store, workers=2, queue_depth=8, coalesce_max=8
+    )
+    try:
+        for p in ps:
+            ing.submit(p)
+        ing.drain()
+    finally:
+        ing.close()
+    # a payload's chunks are buffered until its completion chunk, so
+    # each 2-chunk payload reaches the flush with both chunks present;
+    # whatever way payload completions interleave across passes, at
+    # least one multi-chunk group MUST form (the floor is 2 — greedy
+    # packing across interleaved payloads can strand a tail chunk in a
+    # singleton group; with no interleaving it's all 8)
+    assert ing.counters["coalescedChunks"] >= 2
+    assert ing.counters["coalescedBatches"] >= 1
+    assert ing.counters["fallbacks"] == 0
+    assert_state_parity(
+        sync, mp_store, exact_digest=False, exact_batches=False
+    )
+    # fewer device steps than serial is the whole point
+    assert (
+        mp_store.agg.host_counters["batches"]
+        < sync.agg.host_counters["batches"]
+    )
+    ha, la, _ = mp_store.agg.merged_sketches()
+    counters = dict(mp_store.agg.host_counters)
+    sync.close()
+    mp_store.close()
+    revived = make_wal(tmp_path / "mp")
+    assert revived.agg.host_counters == counters
+    hb, lb, _ = revived.agg.merged_sketches()
+    np.testing.assert_array_equal(ha, hb)
+    np.testing.assert_array_equal(la, lb)
+    revived.close()
+
+
+def test_coalesced_crash_resume_oracle_parity(tmp_path):
+    """The crash-recovery contract survives coalescing: a crash at
+    ``wal.append.mid`` while a coalesced group is being appended tears
+    that ONE record, so the whole group — every chunk it merged — is
+    non-durable together, and the revived store equals an oracle fed
+    only the acked prefix. No torn half-group can replay."""
+    ps = payloads(n_payloads=4, spans_each=5120)  # 2 chunks per payload
+    victim = make_wal(tmp_path / "mp")
+    ing = MultiProcessIngester(
+        victim, workers=2, queue_depth=8, coalesce_max=8
+    )
+    for p in ps[:2]:
+        ing.submit(p)
+    ing.drain()
+    assert ing.counters["coalescedChunks"] >= 2
+    faults.arm("wal.append.mid", action="raise")
+    ing.submit(ps[2])
+    with pytest.raises(RuntimeError):
+        ing.drain()
+    assert isinstance(ing._dispatch_error, faults.CrashpointTriggered)
+    ing.close()
+    del victim
+
+    revived = make_wal(tmp_path / "mp")
+    oracle = TpuStorage(config=CFG, num_devices=2, batch_size=512)
+    for p in ps[:2]:
+        assert oracle.ingest_json_fast(p) is not None
+    assert_state_parity(
+        oracle, revived, exact_digest=False, exact_batches=False
+    )
+
+    # resume coalesced: client retries the unacked payload + new traffic
+    ing2 = MultiProcessIngester(
+        revived, workers=2, queue_depth=8, coalesce_max=8
+    )
+    try:
+        ing2.submit(ps[2])
+        ing2.submit(ps[3])
+        ing2.drain()
+    finally:
+        ing2.close()
+    for p in ps[2:]:
+        assert oracle.ingest_json_fast(p) is not None
+    assert_state_parity(
+        oracle, revived, exact_digest=False, exact_batches=False
+    )
+    counters = dict(revived.agg.host_counters)
+    revived.close()
+    revived2 = make_wal(tmp_path / "mp")
+    assert revived2.agg.host_counters == counters
+    revived2.close()
+    oracle.close()

@@ -1306,3 +1306,77 @@ def test_zt10_shipped_serve_shape_is_clean(tmp_path):
         """,
     )
     assert rules(result) == []
+
+
+def test_zt08_flags_set_active_group_inside_jitted_def(tmp_path):
+    # the coalesced-flush hook arms a thread-local with a slot GROUP —
+    # host-only mutation, same fence as set_active (ISSUE 16)
+    assert_rule_owned(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import critpath
+
+        @jax.jit
+        def kernel(x):
+            critpath.set_active_group(None, [(0, 1)])
+            return x
+        """,
+        "ZT08",
+    )
+
+
+def test_zt08_clean_host_side_group_hooks(tmp_path):
+    # arming the group on the dispatcher before a coalesced device step
+    # is the intended use (mp_ingest._flush_group)
+    result = lint(
+        tmp_path,
+        """
+        import jax
+        from zipkin_tpu.obs import critpath
+
+        @jax.jit
+        def kernel(x):
+            return x + 1
+
+        def flush_group(ledger, pairs):
+            critpath.set_active_group(ledger, pairs)
+            critpath.stamp_active(critpath.SEG_COALESCE, 0, 1)
+            critpath.clear_active()
+            return kernel(len(pairs))
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_zt09_coalesce_gather_shape(tmp_path):
+    # the ring-drain/coalesce functions (concat_remap, _flush_group,
+    # _pump) are zt-dispatch-critical: their loops are per CHUNK of a
+    # bounded coalesced group — pragma'd they lint clean, bare they trip
+    assert_rule_owned(
+        tmp_path,
+        """
+        def concat_remap(parts, out):  # zt-dispatch-critical: the coalesce gather
+            off = 0
+            for fused, svc_map, key_map in parts:
+                out[off] = fused
+                off += 1
+            return off
+        """,
+        "ZT09",
+    )
+    result = lint(
+        tmp_path,
+        """
+        def concat_remap(parts, out):  # zt-dispatch-critical: the coalesce gather
+            off = 0
+            # zt-lint: disable=ZT09 — bounded by coalesce_max CHUNKS;
+            # each iteration is whole-image vectorized
+            for fused, svc_map, key_map in parts:
+                out[off] = fused
+                off += 1
+            return off
+        """,
+    )
+    assert rules(result) == []
+    assert len(result.suppressed) == 1

@@ -90,8 +90,18 @@ def hist_by_name(store: TpuStorage, hist: np.ndarray) -> dict:
     return out
 
 
-def assert_state_parity(a: TpuStorage, b: TpuStorage, exact_digest: bool):
-    assert a.agg.host_counters == b.agg.host_counters
+def assert_state_parity(
+    a: TpuStorage, b: TpuStorage, exact_digest: bool,
+    exact_batches: bool = True,
+):
+    ca_h, cb_h = dict(a.agg.host_counters), dict(b.agg.host_counters)
+    if not exact_batches:
+        # coalesced dispatch merges N chunks into one device call, so
+        # the step count diverges from serial by design; every span-
+        # derived counter must still match exactly
+        ca_h.pop("batches", None)
+        cb_h.pop("batches", None)
+    assert ca_h == cb_h
     ha, la, ca = a.agg.merged_sketches()
     hb, lb, cb = b.agg.merged_sketches()
     if exact_digest:

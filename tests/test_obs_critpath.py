@@ -245,3 +245,55 @@ def test_sigkilled_worker_slots_reclaimed_no_stuck_timelines():
     assert counters["critpathAbandoned"] >= 1
     if wf["timelines"]:
         assert abs(wf["conservation"]["p50"] - 1.0) <= 0.10
+
+
+# -- Little's-law gauges (ISSUE 16 satellite: idle-stitch zeroing) -------
+
+
+def test_littles_law_gauges_nonzero_after_driven_load():
+    """Regression for INGEST_r08's all-zero gauge columns: waterfall()
+    runs its own stitch, and when that stitch folds nothing (the load
+    just drained — the report path's usual timing) the old code zeroed
+    all four gauges before reading them. Post-fix, the gauges keep the
+    last real window until the staleness horizon, so a report taken
+    right after a drained run must show the load that just ran."""
+    from zipkin_tpu import native
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    wf, counters, _ = _mp_run(4, 512, 2, 31)
+    # _mp_run stitched once (folding the payloads) and waterfall()
+    # stitched AGAIN on an idle tracer — the regression's exact shape
+    ll = wf["littlesLaw"]
+    assert ll["lambdaCps"] > 0, ll
+    assert ll["littleL"] > 0, ll
+    assert ll["workerOccupancy"] > 0, ll
+    assert counters["critpathLambdaCps"] > 0
+
+
+def test_gauges_survive_idle_stitches_until_stale_horizon():
+    """Unit shape of the fix: an idle stitch inside the horizon must
+    not touch the gauges; one past the horizon must zero them (a stale
+    saturation reading may not hold an SLO alert forever)."""
+    led = CritPathLedger(1, 8)
+    try:
+        st = CritPathStitcher(led, queue_capacity=4, gauge_stale_s=3600.0)
+        st.lambda_cps = 123.0
+        st.little_l = 4.5
+        st.worker_occupancy = 0.5
+        st.queue_saturation = 0.25
+        st._gauges_at_ns = time.perf_counter_ns()
+        st.stitch()  # idle: nothing to fold, horizon not reached
+        assert st.lambda_cps == 123.0
+        assert st.little_l == 4.5
+        assert st.worker_occupancy == 0.5
+        assert st.queue_saturation == 0.25
+        # back-date the last real window past the horizon
+        st._gauges_at_ns = time.perf_counter_ns() - int(7200 * 1e9)
+        st.stitch()
+        assert st.lambda_cps == 0.0
+        assert st.little_l == 0.0
+        assert st.worker_occupancy == 0.0
+        assert st.queue_saturation == 0.0
+    finally:
+        led.close()

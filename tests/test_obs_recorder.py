@@ -201,7 +201,8 @@ class TestTaxonomy:
             "wal_fsync", "snapshot", "sampler_tick", "archive_write",
             "query_fresh", "query_cached", "readpack_transfer", "mp_record",
             "mp_shm_copy", "mp_vocab_replay", "mp_lut_remap",
-            "mp_device_feed", "accuracy_rollup", "wire_to_durable",
+            "coalesce", "mp_device_feed", "accuracy_rollup",
+            "wire_to_durable",
             "query_lock_wait", "query_wall", "query_mirror",
             "mirror_publish",
         }

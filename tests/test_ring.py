@@ -1,0 +1,233 @@
+"""Span-ring edge cases (ISSUE 16 satellite: wraparound, torn slots).
+
+The ring's correctness story is mostly proven end-to-end by
+tests/test_mp_ingest.py and tests/test_fanout_parity.py (parity,
+worker death, crash-resume); this file pins the shared-memory
+mechanics those tests exercise only incidentally: slot index
+wraparound under sustained load, and the pid-guarded reclaim of a
+slot torn by a SIGKILL mid-write."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.tpu import ring as ring_mod
+from zipkin_tpu.tpu.ring import RingProducer, SpanRing, pack_aux, unpack_aux
+
+
+def _drain_one(ring: SpanRing, w: int = 0):
+    got = ring.peek(w)
+    assert got is not None
+    hdr, seq = got
+    per = int(hdr[ring_mod._S_PER])
+    img = np.array(ring.image(w, seq, per))
+    aux_len = int(hdr[ring_mod._S_AUX_LEN])
+    aux = unpack_aux(ring.aux(w, seq, aux_len)) if aux_len else None
+    ring.free_next(w)
+    return hdr, img, aux
+
+
+def test_wraparound_under_sustained_load():
+    """Sequence numbers wrap the stripe many times over; every publish
+    is consumed intact (payload id, image bytes, sidecar) and claim
+    never observes a stale slot."""
+    ring = SpanRing(1, stripe_slots=4, img_cap_u32=64, aux_cap=4096)
+    prod = RingProducer(ring.params(), 0)
+    try:
+        for i in range(37):  # 9+ full wraps of a 4-slot stripe
+            # fill-then-drain in bursts so head runs ahead of tail by
+            # the full stripe depth, not lockstep 1:1
+            burst = min(4, 37 - i) if i % 4 == 0 else 0
+            prod.claim()
+            # write through a transient view: retaining it would pin the
+            # shm export and make close() fail (the worker loop has the
+            # same discipline)
+            prod.image(8)[:] = np.arange(8, dtype=np.uint32) + i
+            prod.publish(
+                pidx=i, wseq=prod.next_wseq(), per=8,
+                n_spans=5, n_dur=4, n_err=1, dropped=0, cslot=-1,
+                ts_min=i, ts_max=i + 1, parse_ns=0, pack_ns=0,
+                route_ns=0, aux=pack_aux([f"s{i}"], [], [], [], None),
+            )
+            del burst
+            if ring.stripe_full(0):
+                # drain two, keeping the stripe partially full so the
+                # next claims land on wrapped indices
+                for _ in range(2):
+                    hdr, img_out, aux = _drain_one(ring)
+                    j = int(hdr[ring_mod._S_PIDX])
+                    np.testing.assert_array_equal(
+                        img_out, np.arange(8, dtype=np.uint32) + j
+                    )
+                    assert aux[0] == [f"s{j}"]
+        drained = 0
+        while ring.stripe_depth(0) > 0:
+            _drain_one(ring)
+            drained += 1
+        assert drained > 0
+        assert ring.occupancy() == 0
+        # consumption was strictly in publish order
+        assert prod.next_wseq() == 37
+    finally:
+        prod.close()
+        ring.close()
+
+
+def test_peek_ahead_reads_ready_run_in_order():
+    ring = SpanRing(1, stripe_slots=8, img_cap_u32=16, aux_cap=1024)
+    prod = RingProducer(ring.params(), 0)
+    try:
+        for i in range(5):
+            prod.claim()
+            prod.image(4)[:] = i
+            prod.publish(
+                pidx=100 + i, wseq=prod.next_wseq(), per=4,
+                n_spans=1, n_dur=0, n_err=0, dropped=0, cslot=-1,
+                ts_min=0, ts_max=0, parse_ns=0, pack_ns=0, route_ns=0,
+                aux=b"",
+            )
+        for ahead in range(5):
+            hdr, _seq = ring.peek(0, ahead)
+            assert int(hdr[ring_mod._S_PIDX]) == 100 + ahead
+            assert int(hdr[ring_mod._S_WSEQ]) == ahead
+        assert ring.peek(0, 5) is None  # past the published run
+        for _ in range(5):
+            ring.free_next(0)
+        assert ring.peek(0) is None
+    finally:
+        prod.close()
+        ring.close()
+
+
+def _torn_writer(params, barrier):
+    """Child: claim a slot, write half an image, then SIGKILL ourselves
+    mid-write — the slot must be left WRITING with an odd generation."""
+    prod = RingProducer(params, 0)
+    prod.claim()
+    img = prod.image(16)
+    img[:8] = 0xDEAD
+    barrier.wait()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def test_sigkill_mid_write_reclaims_torn_slot():
+    """A producer SIGKILLed between claim and publish leaves a torn
+    WRITING slot. ``reclaim_stripe`` must (a) report it as torn, (b)
+    reset it to FREE with an even generation, and (c) leave the stripe
+    fully reusable by a successor producer — with zero published slots
+    lost (there were none: an unpublished slot was never acked)."""
+    ring = SpanRing(1, stripe_slots=4, img_cap_u32=64, aux_cap=1024)
+    ctx = mp.get_context("spawn")
+    barrier = ctx.Barrier(2)
+    child = ctx.Process(
+        target=_torn_writer, args=(ring.params(), barrier), daemon=True
+    )
+    child.start()
+    try:
+        barrier.wait(timeout=30)
+        child.join(timeout=30)
+        assert not child.is_alive()
+        # the torn slot is invisible to the consumer (never READY)...
+        assert ring.peek(0) is None
+        # ...and reclaim with the dead pid resets it
+        rec = ring.reclaim_stripe(0, child.pid)
+        assert rec == {"discarded": 0, "torn": 1}
+        # stripe is whole again: a successor producer can run a full
+        # publish/consume cycle through the reclaimed slot
+        prod = RingProducer(ring.params(), 0)
+        try:
+            prod.claim()
+            prod.image(4)[:] = 7
+            prod.publish(
+                pidx=1, wseq=prod.next_wseq(), per=4,
+                n_spans=1, n_dur=0, n_err=0, dropped=0, cslot=-1,
+                ts_min=0, ts_max=0, parse_ns=0, pack_ns=0, route_ns=0,
+                aux=b"",
+            )
+            hdr, img, _aux = _drain_one(ring)
+            assert int(hdr[ring_mod._S_PIDX]) == 1
+            np.testing.assert_array_equal(img, np.full(4, 7, np.uint32))
+        finally:
+            prod.close()
+    finally:
+        if child.is_alive():  # pragma: no cover - hang safety
+            child.terminate()
+        ring.close()
+
+
+def test_reclaim_discards_published_but_unconsumed_slots():
+    """Published-but-unconsumed slots of a dead worker are discarded by
+    reclaim (the payloads refeed whole via the dispatcher's fallback
+    path, so consuming them would double-ingest)."""
+    ring = SpanRing(2, stripe_slots=4, img_cap_u32=16, aux_cap=1024)
+    prod = RingProducer(ring.params(), 1)
+    try:
+        for i in range(3):
+            prod.claim()
+            prod.image(2)[:] = i
+            prod.publish(
+                pidx=i, wseq=prod.next_wseq(), per=2,
+                n_spans=1, n_dur=0, n_err=0, dropped=0, cslot=-1,
+                ts_min=0, ts_max=0, parse_ns=0, pack_ns=0, route_ns=0,
+                aux=b"",
+            )
+        rec = ring.reclaim_stripe(1)
+        assert rec == {"discarded": 3, "torn": 0}
+        assert ring.stripe_depth(1) == 0
+        assert ring.peek(1) is None
+        # the sibling stripe is untouched
+        assert ring.stripe_depth(0) == 0
+    finally:
+        prod.close()
+        ring.close()
+
+
+def test_claim_blocks_until_slot_freed():
+    ring = SpanRing(1, stripe_slots=2, img_cap_u32=8, aux_cap=256)
+    prod = RingProducer(ring.params(), 0)
+    try:
+        for i in range(2):
+            prod.claim()
+            prod.publish(
+                pidx=i, wseq=prod.next_wseq(), per=0,
+                n_spans=0, n_dur=0, n_err=0, dropped=0, cslot=-1,
+                ts_min=0, ts_max=0, parse_ns=0, pack_ns=0, route_ns=0,
+                aux=b"",
+            )
+        assert ring.stripe_full(0)
+        assert not prod.try_claim()
+        t0 = time.perf_counter()
+        ring.free_next(0)
+        waited = prod.claim()
+        assert time.perf_counter() - t0 < 5.0
+        assert waited >= 0.0
+    finally:
+        prod.close()
+        ring.close()
+
+
+def test_oversized_sidecar_roundtrip_guard():
+    """pack_aux output larger than aux_cap must be routed around the
+    ring (the worker checks before claiming); the ring itself guards
+    with a hard error rather than silent truncation."""
+    ring = SpanRing(1, stripe_slots=2, img_cap_u32=8, aux_cap=64)
+    prod = RingProducer(ring.params(), 0)
+    try:
+        big = pack_aux(["x" * 1024], [], [], [], None)
+        assert len(big) > prod.aux_cap
+        prod.claim()
+        with pytest.raises(ValueError):
+            prod.publish(
+                pidx=0, wseq=0, per=0, n_spans=0, n_dur=0, n_err=0,
+                dropped=0, cslot=-1, ts_min=0, ts_max=0, parse_ns=0,
+                pack_ns=0, route_ns=0, aux=big,
+            )
+    finally:
+        prod.close()
+        ring.close()

@@ -66,7 +66,8 @@ _HOOK_ATTRS = {
     # folds under a lock — all host-only. A traced region would stamp
     # one trace-time interval forever (or fail under tracing).
     "stamp", "stamp_active", "alloc", "ack", "abandon", "release",
-    "stitch", "calibrate", "set_active", "clear_active",
+    "stitch", "calibrate", "set_active", "set_active_group",
+    "clear_active",
     # query-plane observatory (ISSUE 12): trace arming is thread-local
     # state, the instrumented-lock wrapper measures perf_counter waits,
     # and the stitcher folds under a lock — all host-only. A traced

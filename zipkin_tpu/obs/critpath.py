@@ -82,18 +82,21 @@ SEG_WAL_APPEND = 13     # stamped (dispatcher, via wal.py): append sans fsync
 SEG_WAL_FSYNC = 14      # stamped (dispatcher, via wal.py): the fsync
 SEG_DISPATCH_OTHER = 15  # derived: unstamped time inside dispatcher phase
 SEG_ACK = 16            # derived: last stamped interval -> ack bookkeeping
-N_SEGS = 17
+SEG_RING_WAIT = 17      # stamped (worker): waiting on a free span-ring slot
+SEG_COALESCE = 18       # stamped (dispatcher): multi-chunk concat+remap gather
+N_SEGS = 19
 
 SEG_NAMES = (
     "boundary", "enqueue", "queue_wait", "parse", "slot_wait", "pack",
     "route", "worker_other", "handoff_wait", "shm_copy", "vocab_replay",
     "lut_remap", "device_feed", "wal_append", "wal_fsync",
-    "dispatch_other", "ack",
+    "dispatch_other", "ack", "ring_wait", "coalesce",
 )
 _WAIT = frozenset((SEG_QUEUE_WAIT, SEG_SLOT_WAIT, SEG_WORKER_OTHER,
-                   SEG_HANDOFF_WAIT, SEG_DISPATCH_OTHER))
+                   SEG_HANDOFF_WAIT, SEG_DISPATCH_OTHER, SEG_RING_WAIT))
 SEG_KIND = tuple("wait" if i in _WAIT else "service" for i in range(N_SEGS))
-_WORKER_SEGS = frozenset((SEG_PARSE, SEG_SLOT_WAIT, SEG_PACK, SEG_ROUTE))
+_WORKER_SEGS = frozenset((SEG_PARSE, SEG_SLOT_WAIT, SEG_PACK, SEG_ROUTE,
+                          SEG_RING_WAIT))
 
 # -- shared-memory layout (int64 words) ----------------------------------
 # header | calibration rows (main + one per worker) | slots
@@ -384,17 +387,37 @@ def set_active(ledger: Optional[CritPathLedger], slot: int, pid: int) -> None:
     _active.ledger = ledger if slot >= 0 else None
     _active.slot = slot
     _active.pid = pid
+    _active.group = None
+
+
+def set_active_group(ledger: Optional[CritPathLedger], pairs) -> None:
+    """Arm ``stamp_active`` for a COALESCED flush: ``pairs`` is a list of
+    ``(slot, pid)`` timelines sharing one device/WAL interval. Each
+    traced member gets the same stamped wall window — the flush really
+    did serve all of them at once, so per-timeline conservation holds."""
+    pairs = [(s, p) for s, p in pairs if s >= 0]
+    _active.ledger = ledger if pairs else None
+    _active.slot = -1
+    _active.pid = -1
+    _active.group = pairs or None
 
 
 def clear_active() -> None:
     _active.ledger = None
     _active.slot = -1
+    _active.group = None
 
 
 def stamp_active(code: int, t0_ns: int, t1_ns: int) -> None:  # zt-dispatch-critical: no-op unless a traced payload is being flushed on this thread
     led = getattr(_active, "ledger", None)
-    if led is not None:
+    if led is None:
+        return
+    group = getattr(_active, "group", None)
+    if group is None:
         led.stamp(_active.slot, code, t0_ns, t1_ns, _active.pid)
+        return
+    for slot, pid in group:  # zt-lint: disable=ZT09 — bounded by coalesce_max traced members, word stores only
+        led.stamp(slot, code, t0_ns, t1_ns, pid)
 
 
 def _pctl(sorted_vals: List[int], q: float) -> int:
@@ -414,12 +437,15 @@ class CritPathStitcher:
     def __init__(self, ledger: CritPathLedger, *,
                  queue_capacity: int = 1,
                  recorder=None,
-                 reclaim_age_s: float = 60.0) -> None:
+                 reclaim_age_s: float = 60.0,
+                 gauge_stale_s: float = 60.0) -> None:
         self._ledger = ledger
         self._queue_capacity = max(1, int(queue_capacity))
         self._recorder = recorder
         self.emitter = None  # SelfSpanEmitter, attached by the server
         self._reclaim_age_ns = int(reclaim_age_s * 1e9)
+        self._gauge_stale_ns = int(gauge_stale_s * 1e9)
+        self._gauges_at_ns = 0
         self._lock = threading.Lock()
         self.seg_count = [0] * N_SEGS
         self.seg_sum_us = [0] * N_SEGS
@@ -484,7 +510,8 @@ class CritPathStitcher:
                 walls_us.append(wall)
                 self._walls.append(wall)
                 self._cons.append(tl["conservation"])
-                qwait_us += durs[SEG_QUEUE_WAIT] + durs[SEG_SLOT_WAIT]
+                qwait_us += (durs[SEG_QUEUE_WAIT] + durs[SEG_SLOT_WAIT]
+                             + durs[SEG_RING_WAIT])
                 wserv_us += (durs[SEG_PARSE] + durs[SEG_PACK]
                              + durs[SEG_ROUTE])
                 if self._recorder is not None:
@@ -498,8 +525,12 @@ class CritPathStitcher:
                 led.abandon(s)
                 self.reclaimed += 1
         # Little's law over this stitch window: L = lambda * W. The
-        # gauges describe the just-folded batch; an idle tick zeroes
-        # them so a stale saturation reading cannot hold an SLO alert.
+        # gauges describe the most recent non-idle window; an idle tick
+        # KEEPS them (INGEST_r08 read all zeros because the report-path
+        # stitch after a drained load was always idle and clobbered the
+        # real window) and only a sustained idle spell past the
+        # staleness horizon zeroes them, so a stale saturation reading
+        # still cannot hold an SLO alert forever.
         dt_s = max(1e-9, (now - self._last_ns) / 1e9)
         self._last_ns = now
         if folded:
@@ -513,7 +544,9 @@ class CritPathStitcher:
             self.queue_saturation = (
                 lam * (qwait_us / folded) / 1e6 / self._queue_capacity
             )
-        else:
+            self._gauges_at_ns = now
+        elif (self._gauges_at_ns
+                and now - self._gauges_at_ns > self._gauge_stale_ns):
             self.lambda_cps = 0.0
             self.little_l = 0.0
             self.worker_occupancy = 0.0

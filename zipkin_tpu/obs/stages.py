@@ -41,6 +41,7 @@ STAGES = (
     "mp_vocab_replay",   # mp_record substage: worker vocab journal replay
     "mp_lut_remap",      # mp_record substage: worker-local → global LUT remap
     "mp_device_feed",    # mp_record substage: fused batch → device ingest feed
+    "coalesce",          # multi-chunk concat+remap gather into one bucketed image
     "accuracy_rollup",   # shadow drain + device reads + error estimators
     "wire_to_durable",   # stitched critical path: wire receipt → WAL-durable ack
     "query_lock_wait",   # outermost wait on the aggregator lock (per acquire)
@@ -75,6 +76,7 @@ DEFAULT_BUDGETS_US = {
     "mp_vocab_replay": 250_000,
     "mp_lut_remap": 250_000,
     "mp_device_feed": 500_000,
+    "coalesce": 250_000,
     "accuracy_rollup": 1_000_000,
     "wire_to_durable": 5_000_000,
     "query_lock_wait": 50_000,

@@ -10,8 +10,12 @@ streamed through SMEM in chunks.
 **Measured result (r2, real v5e chip): 10.25 ms vs XLA's 11.54 ms per
 64k updates on [1025, 2048] u8 registers — ~11% faster.** XLA's
 scatter lowering is already near-optimal for this shape, and the HLL
-update is ~15% of a 33 ms ingest step, so the end-to-end win is under
-1% — which is why the default ingest path stays on
+update is a small slice of the ingest step's device time — INGEST_r08
+then showed the step itself is a minority of the wire-to-durable wall
+next to host-side queue-wait (the coalesced ring dispatch in
+tpu/mp_ingest.py attacks that), so the end-to-end win of a faster
+scatter is well under 1% — which is why the default ingest path stays
+on
 :func:`zipkin_tpu.ops.hll.update` and this kernel is opt-in
 (``TPU_PALLAS_HLL=1``). It is kept (a) as the measured evidence closing
 SURVEY.md §7 P4's "Pallas only where profiling says so" question for

@@ -23,13 +23,16 @@ except ImportError:  # jax < 0.6 ships it under experimental
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zipkin_tpu import obs, readpack
+from zipkin_tpu.obs import critpath
 from zipkin_tpu.obs import device as obs_device
 from zipkin_tpu.obs import querytrace
 from zipkin_tpu.ops import linker as dlink
 from zipkin_tpu.tpu import ingest as ing
 from zipkin_tpu.tpu.columnar import (
     SpanColumns,
+    concat_remap,
     fuse_columns,
+    remap_fused,
     route_columns,
     route_fused,
 )
@@ -797,6 +800,71 @@ class ShardedAggregator:
                 self.wal_seq = self.wal_hook(
                     fused, n_spans, n_dur, n_err, ts_range
                 )
+
+    @property
+    def lane_cap(self) -> int:
+        """Hard per-shard lane ceiling of one fused batch — the coalesce
+        planner packs groups up to this (see :meth:`ingest_fused`)."""
+        return min(self.config.digest_buffer, self.config.rollup_segment)
+
+    def ingest_fused_multi(
+        self,
+        parts,
+        n_spans: int,
+        n_dur: int,
+        n_err: int,
+        ts_range=None,
+        pad_to_multiple: int = 256,
+    ) -> None:  # zt-dispatch-critical: the coalesced multi-chunk device entry point
+        """Coalesce N pre-routed chunk images into ONE device batch and
+        fold it with a single jitted step — the span-ring dispatcher's
+        multi-chunk entry point (one ``concat_remap`` + one dispatch +
+        one WAL record for the whole run of ready slots).
+
+        ``parts`` is a sequence of ``(fused, svc_map, key_map)``; each
+        ``fused`` may be a zero-copy ring-slot view — the gather into
+        the freshly allocated bucket image is the only copy it takes,
+        and the remap happens on the copied lanes. The bucket ladder
+        (:func:`zipkin_tpu.tpu.ingest.lane_bucket`) keeps the device
+        shape static across coalesce depths (ZT03). The counts are the
+        caller's sums over the member chunks; pad lanes are zero
+        (valid=0) so the image replays through :meth:`ingest_fused`
+        bit-identically to having ingested it live.
+        """
+        if len(parts) == 1:
+            # degenerate run: identical to the per-chunk path (remap in
+            # place, no bucket padding) so coalesce_max=1 stays
+            # byte-for-byte the pre-ring WAL stream
+            fused, svc_map, key_map = parts[0]
+            t0 = time.perf_counter()
+            t0_ns = time.perf_counter_ns()
+            remap_fused(fused, svc_map, key_map)
+            obs.record("mp_lut_remap", time.perf_counter() - t0)
+            critpath.stamp_active(
+                critpath.SEG_LUT_REMAP, t0_ns, time.perf_counter_ns()
+            )
+            self.ingest_fused(fused, n_spans, n_dur, n_err, ts_range)
+            return
+        # zt-lint: disable=ZT09 — per CHUNK of the coalesced run (bounded
+        # by coalesce_max), integer shape reads only
+        total = sum(int(p[0].shape[-1]) for p in parts)
+        cap = self.lane_cap
+        if total > cap:
+            raise ValueError(
+                f"coalesced run of {total} lanes/shard exceeds the lane "
+                f"cap ({cap}); the planner must split the run"
+            )
+        bucket = ing.lane_bucket(total, pad_to_multiple, cap)
+        shards, rows = parts[0][0].shape[0], parts[0][0].shape[1]
+        t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
+        out = np.zeros((shards, rows, bucket), np.uint32)
+        concat_remap(parts, out)
+        obs.record("coalesce", time.perf_counter() - t0)
+        critpath.stamp_active(
+            critpath.SEG_COALESCE, t0_ns, time.perf_counter_ns()
+        )
+        self.ingest_fused(out, n_spans, n_dur, n_err, ts_range)
 
     def set_sampler_tables(
         self, rate: np.ndarray, tail: np.ndarray, link: np.ndarray

@@ -162,6 +162,8 @@ class ZipkinServer:
                     workers=self.config.tpu_mp_workers,
                     sampler=sampler,
                     queue_depth=self.config.tpu_mp_queue_depth,
+                    ring_slots=self.config.tpu_mp_ring_slots,
+                    coalesce_max=self.config.tpu_mp_coalesce_max,
                     metrics=http_metrics,
                     # ingest critical-path tracer (ISSUE 11): size the
                     # shared-memory interval ledger; 0 disables tracing

@@ -142,6 +142,13 @@ class ServerConfig:
     # the LAST backpressure surface, behind brownout admission and
     # sampling-budget tightening; see runtime/overload.py)
     tpu_mp_queue_depth: int = 2
+    # span-ring stripe depth per worker (tpu/ring.py, ISSUE 16): slots
+    # the dispatcher may lag behind each worker before ring occupancy
+    # pushes back on submit(); 0 = derive (max(4, 2 * queue slots))
+    tpu_mp_ring_slots: int = 0
+    # chunks one dispatcher flush may coalesce into a single remap +
+    # jitted step + WAL record; 1 = per-chunk dispatch (pre-ring parity)
+    tpu_mp_coalesce_max: int = 8
     # overload control plane (runtime/overload.py, ISSUE 13): folds the
     # published pressure signals into a hysteretic load index driving
     # the B0->B3 brownout ladder — B1 sheds expensive observability and
@@ -305,6 +312,8 @@ class ServerConfig:
             tpu_fast_archive_sample=_env_int("TPU_FAST_ARCHIVE_SAMPLE", 64),
             tpu_mp_workers=_env_int("TPU_MP_WORKERS", 0),
             tpu_mp_queue_depth=_env_int("TPU_MP_QUEUE_DEPTH", 2),
+            tpu_mp_ring_slots=_env_int("TPU_MP_RING_SLOTS", 0),
+            tpu_mp_coalesce_max=_env_int("TPU_MP_COALESCE_MAX", 8),
             overload_enabled=_env_bool("TPU_OVERLOAD", True),
             overload_enter_b1=_env_float("TPU_OVERLOAD_ENTER_B1", 0.70),
             overload_enter_b2=_env_float("TPU_OVERLOAD_ENTER_B2", 0.85),

@@ -510,6 +510,33 @@ def remap_fused(
     )
 
 
+def concat_remap(
+    parts, out: np.ndarray
+) -> int:  # zt-dispatch-critical: the coalesce gather — one pass per chunk over the whole coalesced image
+    """Gather N routed chunk images into one bucket-padded image while
+    remapping worker-local ids to global (the span-ring dispatcher's
+    coalesce step: the only copy a ready slot ever takes).
+
+    ``parts`` is a sequence of ``(fused, svc_map, key_map)`` where each
+    ``fused`` is ``[shards, F, per_i]`` (typically a zero-copy view into
+    a ring slot) and the maps are that chunk's local->global LUTs.
+    ``out`` is a zeroed ``[shards, F, bucket]`` destination with
+    ``bucket >= sum(per_i)``. Chunks land lane-contiguous in order;
+    trailing pad lanes stay zero (valid=0 — the same safe-pad invariant
+    as :func:`route_fused`). Remapping happens on the copied lanes, so
+    the shared-memory source is never written. Returns the number of
+    populated lanes per shard.
+    """
+    off = 0
+    for fused, svc_map, key_map in parts:  # zt-lint: disable=ZT09 — bounded by coalesce_max chunks; each iteration is whole-image vectorized
+        per = fused.shape[-1]
+        dst = out[..., off:off + per]
+        dst[:] = fused
+        remap_fused(dst, svc_map, key_map)
+        off += per
+    return off
+
+
 def route_columns(
     cols: SpanColumns, n_shards: int, pad_to_multiple: int = 256
 ) -> SpanColumns:

@@ -31,6 +31,24 @@ from zipkin_tpu.tpu.state import (
 )
 
 
+def lane_bucket(lanes: int, pad_to_multiple: int, cap: int) -> int:
+    """Static-shape bucket for a coalesced multi-chunk lane count.
+
+    The coalesced dispatch path (span ring, mp_ingest) concatenates N
+    routed chunk images into one device batch; feeding the raw sum of
+    lane counts to the jitted step would compile a fresh program per
+    distinct sum (the ZT03 failure mode). Instead the sum is rounded up
+    a doubling ladder anchored at the packer's pad multiple —
+    ``pad * 2^k`` capped at the aggregator's lane ceiling — so at most
+    ``log2(cap/pad)+1`` programs ever exist. Pad lanes are zero
+    (valid=0), the same safe-pad invariant the router relies on.
+    """
+    b = max(1, int(pad_to_multiple))
+    while b < lanes:
+        b *= 2
+    return min(b, cap) if cap >= lanes else b
+
+
 def _hll_update(registers, rows, hashes, valid):
     """HLL update with the opt-in Pallas backend (TPU_PALLAS_HLL=1).
 

@@ -5,45 +5,68 @@ The reference scales ingest horizontally with N collector workers/nodes
 under CPython one process cannot: the r2 profile measured the device path
 at ~490k spans/s/chip with the host parse GIL-serialized, and a threaded
 feeder measured SLOWER (tpu/feeder.py). This module is the multi-process
-fan-out tier (ISSUE 8), the collector's real fast path for both JSON v2
-and proto3 payloads over HTTP and gRPC:
+fan-out tier (ISSUE 8, rebuilt around the span ring in ISSUE 16), the
+collector's real fast path for both JSON v2 and proto3 payloads over
+HTTP and gRPC:
 
 - **N parse workers** (``spawn``, never importing jax): raw JSON/proto3
   bytes -> native C parse + LOCAL vocab interning -> columnar pack ->
-  trace-affine shard routing -> the packed 11-row wire image written into
-  a shared-memory slot. Workers journal newly-interned strings per batch
-  and ship their parse/pack/route wall time so the obs stage taxonomy
-  covers the tier end-to-end.
-- **One dispatcher thread** (main process, owns the device): applies each
-  worker's vocab journal to the GLOBAL vocab, remaps the image's packed
-  service/key lanes worker-local -> global with vectorized table lookups
-  (``columnar.remap_fused``), then ``ingest_fused`` (device_put + jit
-  step). WAL append and sampling verdicts ride ``ingest_fused`` on this
-  side, so ack-after-durability semantics are bit-identical to the
-  serial path. Remapping is what lets workers intern lock-free: ids only
-  need to be consistent per-worker; the journal replays them into one
-  global id space.
+  trace-affine shard routing -> the packed 11-row wire image written
+  straight into a **shared-memory span-ring slot** (tpu/ring.py)
+  together with the chunk's pickled sidecar (vocab journal, archive
+  slices, disk record). No per-chunk metadata message, no pickling of
+  the image: publishing a slot is a handful of word stores behind a
+  seqlock generation, and the per-worker stripe makes the handoff
+  lock-free in both directions.
+- **One dispatcher thread** (main process, owns the device): drains
+  contiguous runs of READY slots per stripe, replays each chunk's vocab
+  journal into the GLOBAL vocab, then flushes completed payloads in
+  **coalesced groups**: up to ``coalesce_max`` chunks (bounded by the
+  aggregator's lane cap) become ONE ``concat_remap`` gather into a
+  bucket-padded image + ONE jitted ingest step + ONE WAL record, acked
+  together — amortizing the ~16 µs/span per-chunk dispatch overhead
+  INGEST_r08 measured. The chunk image is consumed as a zero-copy view
+  into its ring slot; the coalesce gather (or, at ``coalesce_max=1``,
+  the same per-chunk copy+remap as before) is the only copy it takes.
+  WAL append and sampling verdicts ride ``ingest_fused`` on this side,
+  so ack-after-durability semantics are bit-identical to the serial
+  path. Remapping is what lets workers intern lock-free: ids only need
+  to be consistent per-worker; the journal replays them into one global
+  id space.
 
-Backpressure contract: each worker owns a BOUNDED queue. ``submit(...,
-block=False)`` — the server-boundary mode — raises
-:class:`IngestBackpressure` when every live worker's queue is full; the
-HTTP site maps it to 429 and the gRPC site to RESOURCE_EXHAUSTED so
-senders back off instead of the tier buffering unboundedly. Since
-ISSUE 13 the queue-full rejection is the LAST backpressure surface,
-not the only one: the overload control plane (runtime/overload.py)
-sheds bulk-class payloads at the collector boundary before they reach
-these queues (B2/B3 brownout admission), tightens the sampling tier's
+Ordering across the two channels (ring slots for images, the result
+queue for oversized sidecars / strict-codec punts / EOF) is pinned by a
+per-worker chunk sequence number: the dispatcher applies a worker's
+chunks strictly in ``wseq`` order, holding back whichever channel runs
+ahead, so a payload's chunks — and its vocab-journal deltas — replay in
+exactly the order the worker produced them.
+
+Backpressure contract: ring occupancy is the tier's backpressure basis.
+A full stripe stalls its worker's blocking ``claim()``, the stalled
+worker stops pulling from its bounded delivery queue, and the queue
+fills — so ring congestion propagates to the submit boundary without
+ever rejecting while a queue slot is free (routing merely PREFERS
+workers with stripe headroom). ``submit(..., block=False)`` — the
+server-boundary mode — raises :class:`IngestBackpressure` only when
+every live worker's delivery queue is full. The HTTP site maps it to 429
+and the gRPC site to RESOURCE_EXHAUSTED so senders back off instead of
+the tier buffering unboundedly. Since ISSUE 13 that rejection is the
+LAST backpressure surface, not the only one: the overload control plane
+(runtime/overload.py) sheds bulk-class payloads at the collector
+boundary first (B2/B3 brownout admission), tightens the sampling tier's
 budget under sustained pressure, and stamps every rejection with
 jittered backoff guidance (``Retry-After`` / ``retry-delay``).
 
 Zero-loss worker death: the dispatcher retains every submitted payload
 (``_pending``) until its results are APPLIED, and buffers per-payload
 state mutations until the payload's completion chunk arrives. A worker
-that dies mid-payload therefore loses nothing: its buffered chunks are
-discarded (never applied, so no double-ingest) and every payload it
-owned — queued or in-process — re-ingests on the slow path. The pool
-keeps serving on the survivors; only a dead DISPATCHER (device failure)
-surfaces as an error to submit()/drain().
+that dies mid-payload therefore loses nothing: its ring stripe is
+reclaimed (published-but-unconsumed slots discarded, the torn
+mid-write slot a SIGKILL leaves reset via the pid guard), its buffered
+chunks are discarded (never applied, so no double-ingest) and every
+payload it owned — queued or in-process — re-ingests on the slow path.
+The pool keeps serving on the survivors; only a dead DISPATCHER (device
+failure) surfaces as an error to submit()/drain().
 
 Sampled archive parity: workers extract the same trace-affine 1/N span
 slices the synchronous fast path archives (byte extents from the native
@@ -59,7 +82,6 @@ with worker count while the dispatcher stays a thin device feeder.
 
 from __future__ import annotations
 
-import itertools
 import logging
 import multiprocessing as mp
 import queue
@@ -71,23 +93,32 @@ import numpy as np
 
 from zipkin_tpu import faults, obs
 from zipkin_tpu.obs import critpath as _critpath
+from zipkin_tpu.tpu import ring as ring_mod
 
 logger = logging.getLogger(__name__)
 
-# worker -> dispatcher message kinds
-_KIND_BATCH = 0
-_KIND_FALLBACK = 1
-_KIND_EOF = 2
+# worker -> dispatcher result-queue message kinds. Chunk IMAGES travel
+# through the span ring; the queue carries only what cannot ride a
+# bounded slot (oversized sidecars, empty-payload completions), the
+# strict-codec punts, and EOF.
+_KIND_BATCH = 0      # (kind, widx, pid, wseq, fused|None, n_spans, n_dur,
+#                       n_err, dropped, svc_new, name_new, pairs_new,
+#                       arch, ts_range, rec, parse_s, pack_s, route_s)
+_KIND_FALLBACK = 1   # (kind, widx, pid, wseq)
+_KIND_EOF = 2        # (kind, widx)
+_KIND_NUDGE = 3      # (kind,) — wakeup only: a ring slot was published
 
 
 class IngestBackpressure(RuntimeError):
     """The ingest tier refused a payload it could not absorb: every
-    live parse worker's queue is full (``submit(..., block=False)``),
-    the brownout ladder shed it (collector admission, ISSUE 13), or an
-    injected allocation failure fired. The server boundary maps it to
-    HTTP 429 / gRPC RESOURCE_EXHAUSTED — with the overload
-    controller's jittered backoff guidance attached — so senders back
-    off and retry instead of the tier buffering unboundedly."""
+    live parse worker's delivery queue is full — each backed up behind
+    a congested ring stripe or a busy worker — in
+    ``submit(..., block=False)``, the brownout ladder shed it
+    (collector admission, ISSUE 13), or an injected allocation failure
+    fired. The server boundary maps it to HTTP 429 / gRPC
+    RESOURCE_EXHAUSTED — with the overload controller's jittered
+    backoff guidance attached — so senders back off and retry instead
+    of the tier buffering unboundedly."""
 
 
 def _extract_archive_slices(parsed, every: int) -> List[bytes]:
@@ -110,30 +141,25 @@ def _worker_main(
     widx: int,
     work_q,
     result_q,
-    shm_name: str,
-    slot_bytes: int,
-    slot_base: int,
-    n_slots: int,
-    slot_sem,
+    ring_params: dict,
     params: dict,
 ) -> None:
     """Parse worker entry point (child process; numpy + C parser only —
     importing jax here would drag a PJRT client into every worker)."""
-    from multiprocessing import shared_memory
-
     from zipkin_tpu import native
     from zipkin_tpu.native import PARSED_FIELDS
     from zipkin_tpu.obs.critpath import (
         SEG_PACK,
         SEG_PARSE,
+        SEG_RING_WAIT,
         SEG_ROUTE,
-        SEG_SLOT_WAIT,
         CritPathWorkerView,
     )
     from zipkin_tpu.tpu.archive import parsed_record
     from zipkin_tpu.tpu.columnar import Vocab, pack_parsed, route_fused
+    from zipkin_tpu.tpu.ring import RingProducer, pack_aux
 
-    shm = shared_memory.SharedMemory(name=shm_name)
+    prod = RingProducer(ring_params, widx)
     cp_params = params.get("critpath")
     cview = (
         CritPathWorkerView(cp_params, widx) if cp_params is not None else None
@@ -149,7 +175,6 @@ def _worker_main(
     boundary = params["sample_boundary"]  # None = keep everything
     # journal cursors: how much of the local vocab has been reported
     sent_svc, sent_name, sent_pair = 1, 1, 1
-    slot_ids = itertools.cycle(range(n_slots))
 
     def handle(pid: int, payload: bytes, state: dict, cslot: int) -> None:
         nonlocal sent_svc, sent_name, sent_pair
@@ -172,7 +197,7 @@ def _worker_main(
             # the strict-codec fallback needs Span objects: punt back to
             # the dispatcher, which still holds the payload bytes
             state["completed"] = True
-            result_q.put((_KIND_FALLBACK, widx, pid))
+            result_q.put((_KIND_FALLBACK, widx, pid, prod.next_wseq()))
             return
         nvocab.sync()
         n = parsed.n
@@ -196,8 +221,8 @@ def _worker_main(
         if n == 0:
             state["completed"] = True
             result_q.put(
-                (_KIND_BATCH, widx, pid, None, None, 0, 0, 0, dropped,
-                 [], [], [], [], (0, 0), None, parse_s, 0.0, 0.0)
+                (_KIND_BATCH, widx, pid, prod.next_wseq(), None, 0, 0, 0,
+                 dropped, [], [], [], [], (0, 0), None, parse_s, 0.0, 0.0)
             )
             return
         for lo in range(0, n, max_batch):
@@ -217,6 +242,12 @@ def _worker_main(
             fused = route_fused(cols, n_shards)
             route_s = time.perf_counter() - t2
             pack_s = t2 - t1
+            if traced:
+                cview.stamp(cslot, SEG_PACK, int(t1 * 1e9), int(t2 * 1e9))
+                cview.stamp(
+                    cslot, SEG_ROUTE, int(t2 * 1e9),
+                    int((t2 + route_s) * 1e9),
+                )
             arch = _extract_archive_slices(sub, every)
             rec = parsed_record(sub) if disk else None
             # vocab journal since the last report (id order)
@@ -226,24 +257,9 @@ def _worker_main(
             sent_svc += len(svc_new)
             sent_name += len(name_new)
             sent_pair += len(pairs_new)
-            ta = time.perf_counter()
-            slot_sem.acquire()
-            if traced:
-                tb = time.perf_counter()
-                cview.stamp(cslot, SEG_PACK, int(t1 * 1e9), int(t2 * 1e9))
-                cview.stamp(
-                    cslot, SEG_ROUTE, int(t2 * 1e9),
-                    int((t2 + route_s) * 1e9),
-                )
-                cview.stamp(
-                    cslot, SEG_SLOT_WAIT, int(ta * 1e9), int(tb * 1e9)
-                )
-            slot = next(slot_ids)
-            dst = np.frombuffer(
-                shm.buf, np.uint32, count=fused.size,
-                offset=slot_base + slot * slot_bytes,
-            )
-            dst[:] = fused.reshape(-1)
+            n_spans = int(cols.valid.sum())
+            n_dur = int((cols.valid & cols.has_dur).sum())
+            n_err = int((cols.valid & cols.err).sum())
             live_ts = cols.ts_min[cols.valid]
             ts_range = (
                 (int(live_ts.min()), int(live_ts.max()))
@@ -252,24 +268,54 @@ def _worker_main(
             )
             # -1 marks a continuation chunk: the dispatcher completes a
             # payload (applies its buffered chunks, decrements inflight)
-            # on the LAST chunk's message only, so drain() can never
-            # return while later chunks are still queued or being packed
-            # (ADVICE r3). The sampled-drop count and the parse timing
-            # ride the completion chunk.
+            # on the LAST chunk only, so drain() can never return while
+            # later chunks are still queued or being packed (ADVICE r3).
+            # The sampled-drop count rides the completion chunk.
             is_last = hi == n
             if is_last:
                 state["completed"] = True
-            result_q.put(
-                (
-                    _KIND_BATCH, widx, pid, slot, fused.shape,
-                    int(cols.valid.sum()),
-                    int((cols.valid & cols.has_dur).sum()),
-                    int((cols.valid & cols.err).sum()),
-                    dropped if is_last else -1,
-                    svc_new, name_new, pairs_new, arch, ts_range, rec,
-                    parse_s if is_last else 0.0, pack_s, route_s,
+            aux = pack_aux(svc_new, name_new, pairs_new, arch, rec)
+            if fused.size <= prod.img_cap_u32 and len(aux) <= prod.aux_cap:
+                ta = time.perf_counter()
+                prod.claim()
+                tb = time.perf_counter()
+                if traced:
+                    cview.stamp(
+                        cslot, SEG_RING_WAIT, int(ta * 1e9), int(tb * 1e9)
+                    )
+                prod.image(fused.size)[:] = fused.reshape(-1)
+                # the wseq is allocated at the last infallible instant
+                # before emission on BOTH channels, so a worker that
+                # survives an exception can never leave a sequence gap
+                # that would stall the dispatcher's in-order pump
+                prod.publish(
+                    pidx=pid, wseq=prod.next_wseq(),
+                    per=int(fused.shape[-1]),
+                    n_spans=n_spans, n_dur=n_dur, n_err=n_err,
+                    dropped=dropped if is_last else -1,
+                    cslot=cslot if traced else -1,
+                    ts_min=ts_range[0], ts_max=ts_range[1],
+                    parse_ns=int(parse_s * 1e9),
+                    pack_ns=int(pack_s * 1e9),
+                    route_ns=int(route_s * 1e9),
+                    aux=aux,
                 )
-            )
+                # a ring publish carries no wakeup of its own: nudge
+                # the dispatcher so a backed-off idle poll (up to
+                # 50 ms) doesn't sit out its full interval while a
+                # ready slot waits
+                result_q.put((_KIND_NUDGE,))
+            else:
+                # sidecar outgrew the bounded slot (huge disk-archive
+                # record): ship the whole chunk through the queue — the
+                # wseq keeps it ordered against the ring chunks
+                result_q.put(
+                    (_KIND_BATCH, widx, pid, prod.next_wseq(), fused,
+                     n_spans, n_dur, n_err,
+                     dropped if is_last else -1,
+                     svc_new, name_new, pairs_new, arch, ts_range, rec,
+                     parse_s, pack_s, route_s)
+                )
             parse_s = 0.0  # only bill the parse once per payload
 
     try:
@@ -290,12 +336,14 @@ def _worker_main(
                     # completion marker, so any chunks this payload DID
                     # ship were never applied: a whole-payload fallback
                     # retry cannot double-ingest, and nothing is lost
-                    result_q.put((_KIND_FALLBACK, widx, pid))
+                    result_q.put(
+                        (_KIND_FALLBACK, widx, pid, prod.next_wseq())
+                    )
     finally:
         result_q.put((_KIND_EOF, widx))
         if cview is not None:
             cview.close()
-        shm.close()
+        prod.close()
 
 
 class _IdMaps:
@@ -312,17 +360,20 @@ class _IdMaps:
 
 
 class MultiProcessIngester:
-    """Owns the worker pool + shared-memory slots + dispatcher thread.
+    """Owns the worker pool + the span ring + the dispatcher thread.
 
     ``submit(payload)`` enqueues raw JSON v2 / proto3 bytes onto one
-    worker's bounded queue and returns once the payload is accepted.
+    live worker and returns once the payload is accepted.
     ``submit(payload, block=False)`` — the server boundary's mode —
     raises :class:`IngestBackpressure` instead of blocking when every
-    live worker's queue is full. ``drain()`` blocks until everything
-    submitted has reached the device. Parity with
-    ``TpuStorage.ingest_json_fast`` — same sketches, same sampling
-    verdicts, same WAL contents — is asserted in tests/test_mp_ingest.py
-    and tests/test_fanout_parity.py.
+    live worker is saturated (ring stripe or delivery queue full).
+    ``drain()`` blocks until everything submitted has reached the
+    device. ``coalesce_max`` bounds how many ready chunks one flush may
+    merge into a single device step + WAL record; the default of 1
+    keeps per-chunk dispatch — and the WAL byte stream — identical to
+    the pre-ring path. Parity with ``TpuStorage.ingest_json_fast`` —
+    same sketches, same sampling verdicts, same WAL contents — is
+    asserted in tests/test_mp_ingest.py and tests/test_fanout_parity.py.
     """
 
     def __init__(
@@ -335,6 +386,9 @@ class MultiProcessIngester:
         metrics=None,
         critpath_slots: int = 0,
         critpath_reclaim_s: float = 60.0,
+        ring_slots: int = 0,
+        coalesce_max: int = 1,
+        ring_aux_bytes: int = 1 << 20,
     ) -> None:
         from zipkin_tpu import native
         from zipkin_tpu.tpu.columnar import WIRE_ROWS
@@ -344,27 +398,31 @@ class MultiProcessIngester:
         self.store = store
         self.workers = workers
         self.queue_depth = queue_depth or 2  # PER-WORKER payload bound
+        self.coalesce_max = max(1, int(coalesce_max))
         self._sampler = sampler
         agg = store.agg
+        self._n_shards = agg.n_shards
+        self._wire_rows = WIRE_ROWS
         # worst case: every span of a max_batch chunk routes to one
         # shard, and route_fused rounds the per-shard lane count up to
-        # its 256 pad multiple — slots must cover the ROUNDED bound or a
-        # near-full chunk would write past its slot
+        # its 256 pad multiple — ring slots must cover the ROUNDED bound
+        # or a near-full chunk would spill past its image region
         per_cap = ((store.max_batch + 255) // 256) * 256
-        self._slot_bytes = agg.n_shards * WIRE_ROWS * per_cap * 4
-        self._slots_per_worker = slots_per_worker
+        img_cap_u32 = agg.n_shards * WIRE_ROWS * per_cap
+        stripe = int(ring_slots) if ring_slots else max(
+            4, 2 * slots_per_worker
+        )
+        self._ring = ring_mod.SpanRing(
+            workers, stripe, img_cap_u32, aux_cap=int(ring_aux_bytes)
+        )
         ctx = mp.get_context("spawn")
-        total = self._slot_bytes * slots_per_worker * workers
-        from multiprocessing import shared_memory
-
-        self._shm = shared_memory.SharedMemory(create=True, size=total)
-        # one bounded queue per worker: backpressure is per-worker, and a
-        # dead worker's queue can be salvaged without racing survivors
+        # one bounded delivery queue per worker: payload handoff + the
+        # second backpressure surface (a frozen worker's stripe stays
+        # empty, so ring occupancy alone would never push back on it)
         self._work_qs = [
             ctx.Queue(maxsize=self.queue_depth) for _ in range(workers)
         ]
         self._result_q = ctx.Queue()
-        self._sems = [ctx.Semaphore(slots_per_worker) for _ in range(workers)]
         has_disk = getattr(store, "_disk", None) is not None
         params = dict(
             max_services=store.vocab.services.capacity,
@@ -412,10 +470,8 @@ class MultiProcessIngester:
             ctx.Process(
                 target=_worker_main,
                 args=(
-                    w, self._work_qs[w], self._result_q, self._shm.name,
-                    self._slot_bytes,
-                    w * slots_per_worker * self._slot_bytes,
-                    slots_per_worker, self._sems[w], params,
+                    w, self._work_qs[w], self._result_q,
+                    self._ring.params(), params,
                 ),
                 daemon=True,
             )
@@ -425,15 +481,18 @@ class MultiProcessIngester:
             p.start()
         self.metrics = metrics  # CollectorMetrics-shaped, optional
         # accuracy-observatory tap (obs/shadow.py): when attached, every
-        # applied chunk's fused image is offered (O(1) bounded append —
-        # the fused array is already this dispatcher's private copy)
+        # applied chunk's fused image is offered (ring-slot views are
+        # copied first — the tap may retain its argument past the slot's
+        # reuse)
         self.shadow = None
         self.counters = {
             "accepted": 0, "sampleDropped": 0, "fallbacks": 0, "rejected": 0,
+            "coalescedBatches": 0, "coalescedChunks": 0,
+            "ringDiscarded": 0, "ringTorn": 0,
         }
-        # per-worker attribution (batch messages carry widx): a slow
-        # worker is distinguishable from a slow pool. Mutated only on
-        # the dispatcher thread; read lock-free by stats().
+        # per-worker attribution (chunks carry widx): a slow worker is
+        # distinguishable from a slow pool. Mutated only on the
+        # dispatcher thread; read lock-free by stats().
         self._wstats = [
             {"chunks": 0, "spans": 0, "payloads": 0, "parseUs": 0,
              "packUs": 0, "routeUs": 0, "fallbacks": 0}
@@ -444,6 +503,7 @@ class MultiProcessIngester:
         # cumulative tallies above cannot show. Mutated under _cv.
         self._qdepth = [0] * workers
         self._qhigh = [0] * workers
+        self._ring_high = 0
         self._inflight = 0
         self._cv = threading.Condition()
         self._closed = False
@@ -462,11 +522,20 @@ class MultiProcessIngester:
         self._maps: List[Optional[_IdMaps]] = [
             _IdMaps() for _ in range(workers)
         ]
-        # reap reentrancy guard: _reap_dead_workers drains result_q via
-        # _handle_msg, which can discover ANOTHER premature EOF — a
-        # recursive reap would abort the outer one before its salvage
-        # ran (ADVICE r4). Extra dead workers found mid-reap are
-        # collected here and folded into the current reap instead.
+        # cross-channel in-order pump state (dispatcher thread only):
+        # the next wseq to apply per worker, plus queue messages that
+        # arrived ahead of their turn
+        self._expected = [0] * workers
+        self._holdback: List[Dict[int, tuple]] = [
+            {} for _ in range(workers)
+        ]
+        self._pending_eof: Set[int] = set()
+        self._reap_later: List[int] = []
+        # reap reentrancy guard: _reap_dead_workers drains result_q and
+        # pumps, which can discover ANOTHER premature EOF — a recursive
+        # reap would abort the outer one before its salvage ran
+        # (ADVICE r4). Extra dead workers found mid-reap are collected
+        # here and folded into the current reap instead.
         self._reaping = False
         self._reap_extra: List[int] = []
         self._dispatcher = threading.Thread(
@@ -477,13 +546,15 @@ class MultiProcessIngester:
     # -- producer side ---------------------------------------------------
 
     def submit(self, payload: bytes, *, block: bool = True) -> None:
-        """Enqueue a payload onto one live worker's bounded queue.
+        """Enqueue a payload onto one live unsaturated worker.
 
         Registration happens BEFORE the queue put (under _cv, the same
         lock the reaper takes to mark workers dead), so a worker-death
         reap is linearized against submission: either the reap sees the
         registration and refeeds the payload, or submit() sees the
-        worker marked dead and picks another.
+        worker marked dead and picks another. A worker whose ring
+        stripe is full is skipped exactly like one whose queue is full
+        — ring occupancy is the tier's backpressure basis.
         """
         while True:
             if self._closed:
@@ -512,44 +583,59 @@ class MultiProcessIngester:
                 if self._cp_ledger is not None
                 else 0
             )
-            for w in live[start:] + live[:start]:
-                with self._cv:
-                    if w in self._dead:
+            for relax in (False, True):
+                for w in live[start:] + live[:start]:
+                    with self._cv:
+                        if w in self._dead:
+                            continue
+                        self._assigned[pid] = w
+                    if not relax and self._ring.stripe_full(w):
+                        # the dispatcher is behind on this stripe:
+                        # first round prefers a worker with drain
+                        # headroom. Ring congestion alone must NOT
+                        # reject — the worker's blocking claim()
+                        # propagates the ring bound back through its
+                        # delivery queue — so a second round relaxes
+                        # the check and only full queues remain
+                        with self._cv:
+                            if pid not in self._pending:
+                                return  # a racing reap already refed it
+                            if self._assigned.get(pid) == w:
+                                self._assigned.pop(pid)
                         continue
-                    self._assigned[pid] = w
-                cslot = -1
-                if wire_ns:
-                    t_en0 = time.perf_counter_ns()
-                    cslot = self._cp_ledger.alloc(pid, w, wire_ns)
-                    if cslot >= 0:
-                        # stamp + register BEFORE the queue put: the
-                        # dispatcher only writes this slot after the
-                        # worker's result message, so main-side region
-                        # writers stay causally serialized
-                        self._cp_ledger.stamp(
-                            cslot, _critpath.SEG_ENQUEUE, t_en0,
-                            time.perf_counter_ns(), pid,
-                        )
+                    cslot = -1
+                    if wire_ns:
+                        t_en0 = time.perf_counter_ns()
+                        cslot = self._cp_ledger.alloc(pid, w, wire_ns)
+                        if cslot >= 0:
+                            # stamp + register BEFORE the queue put: the
+                            # dispatcher only writes this slot after the
+                            # worker's chunk arrives, so main-side
+                            # region writers stay causally serialized
+                            self._cp_ledger.stamp(
+                                cslot, _critpath.SEG_ENQUEUE, t_en0,
+                                time.perf_counter_ns(), pid,
+                            )
+                            with self._cv:
+                                self._cslots[pid] = cslot
+                    try:
+                        self._work_qs[w].put_nowait((pid, payload, cslot))
                         with self._cv:
-                            self._cslots[pid] = cslot
-                try:
-                    self._work_qs[w].put_nowait((pid, payload, cslot))
-                    with self._cv:
-                        self._qdepth[w] += 1
-                        if self._qdepth[w] > self._qhigh[w]:
-                            self._qhigh[w] = self._qdepth[w]
-                    return
-                except queue.Full:
-                    if cslot >= 0:
+                            self._qdepth[w] += 1
+                            if self._qdepth[w] > self._qhigh[w]:
+                                self._qhigh[w] = self._qdepth[w]
+                        return
+                    except queue.Full:
+                        if cslot >= 0:
+                            with self._cv:
+                                self._cslots.pop(pid, None)
+                            self._cp_ledger.abandon(cslot)
                         with self._cv:
-                            self._cslots.pop(pid, None)
-                        self._cp_ledger.abandon(cslot)
-                    with self._cv:
-                        if pid not in self._pending:
-                            return  # a racing reap already refed it
-                        if self._assigned.get(pid) == w:
-                            self._assigned.pop(pid)
-            # every live queue is full: roll the registration back
+                            if pid not in self._pending:
+                                return  # a racing reap already refed it
+                            if self._assigned.get(pid) == w:
+                                self._assigned.pop(pid)
+            # every live worker is saturated: roll the registration back
             with self._cv:
                 if pid not in self._pending:
                     return  # a racing reap consumed it
@@ -561,9 +647,11 @@ class MultiProcessIngester:
             if not block:
                 self.counters["rejected"] += 1
                 raise IngestBackpressure(
-                    f"every parse-worker queue is full "
-                    f"({len(live)} workers x depth {self.queue_depth}); "
-                    "retry after backoff"
+                    f"ingest fan-out saturated: every live worker's "
+                    f"delivery queue is full behind its ring stripe "
+                    f"({len(live)} workers x queue depth "
+                    f"{self.queue_depth}, {self._ring.stripe_slots} "
+                    f"ring slots each); retry after backoff"
                 )
             time.sleep(0.002)
 
@@ -597,11 +685,20 @@ class MultiProcessIngester:
             "mpSampleDropped": self.counters["sampleDropped"],
             "mpFallbacks": self.counters["fallbacks"],
             "mpRejected": self.counters["rejected"],
+            "mpRingSlots": self._ring.capacity,
+            "mpRingOccupancy": self._ring.occupancy(),
+            "mpRingHighWater": self._ring_high,
+            "mpCoalesceMax": self.coalesce_max,
+            "mpCoalescedBatches": self.counters["coalescedBatches"],
+            "mpCoalescedChunks": self.counters["coalescedChunks"],
+            "mpRingDiscarded": self.counters["ringDiscarded"],
+            "mpRingTorn": self.counters["ringTorn"],
             # nested per-worker table — scalar-only consumers
             # (/prometheus gauge emission) skip non-scalar values
             "mpWorkerTable": [
                 {"widx": w, "alive": w not in self._dead,
                  "queueDepth": qdepth[w], "queueHighWater": qhigh[w],
+                 "ringDepth": self._ring.stripe_depth(w),
                  **dict(ws)}
                 for w, ws in enumerate(self._wstats)
             ],
@@ -639,22 +736,18 @@ class MultiProcessIngester:
             q.close()
             q.cancel_join_thread()
         if self._dispatch_error is not None:
-            # the stored exception's traceback pins the _handle_msg
-            # frame, whose locals include an ndarray VIEW into a shm
-            # slot — shm.close() would refuse ("exported pointers
-            # exist"). The dispatcher thread is joined, so the frames
-            # are safe to clear; drain()'s re-raise keeps the message.
+            # the stored exception's traceback pins frames whose locals
+            # can include ndarray VIEWS into ring slots — shm close()
+            # would refuse ("exported pointers exist"). The dispatcher
+            # thread is joined, so the frames are safe to clear;
+            # drain()'s re-raise keeps the message.
             import traceback
 
             tb = self._dispatch_error.__traceback__
             if tb is not None:
                 traceback.clear_frames(tb)
         self._buffered.clear()
-        self._shm.close()
-        try:
-            self._shm.unlink()
-        except FileNotFoundError:  # pragma: no cover
-            pass
+        self._ring.close()
         if self._cp_ledger is not None:
             self._cp_ledger.close()
 
@@ -672,38 +765,49 @@ class MultiProcessIngester:
 
     def _sink_until_closed(self) -> None:
         """After a dispatcher failure, keep draining result_q and
-        releasing shm slots so SURVIVING workers never wedge in
-        slot_sem.acquire() with the only release site (the normal
-        dispatch loop) gone — otherwise close() would burn its full join
-        timeout per live worker and terminate() it mid-payload. Results
-        are discarded: the error is already surfaced to submit()/drain(),
+        freeing ring slots so SURVIVING workers never wedge in
+        ``claim()`` with the only consumer (the normal dispatch loop)
+        gone — otherwise close() would burn its full join timeout per
+        live worker and terminate() it mid-payload. Results are
+        discarded: the error is already surfaced to submit()/drain(),
         so callers know batches after the failure point are lost."""
         while True:
+            for w in range(self.workers):
+                while self._ring.stripe_depth(w) > 0:
+                    self._ring.free_next(w)
             try:
-                msg = self._result_q.get(timeout=0.25)
+                self._result_q.get(timeout=0.25)
             except queue.Empty:
                 if self._closed and not any(p.is_alive() for p in self._procs):
                     return
-                continue
-            if msg[0] == _KIND_BATCH and msg[3] is not None:
-                self._sems[msg[1]].release()
 
     def _run_dispatch(self) -> None:
         eof_set: set = set()
         last_liveness = time.monotonic()
+        idle_wait = 0.0005
         while len(eof_set) < self.workers:
-            try:
-                msg = self._result_q.get(timeout=0.5)
-            except queue.Empty:
-                if self._closed and not any(p.is_alive() for p in self._procs):
-                    break
-                if not self._closed:
-                    self._check_liveness(eof_set)
-                    last_liveness = time.monotonic()
-                continue
-            self._handle_msg(msg, eof_set)
+            if self._pass(eof_set):
+                idle_wait = 0.0005
+            else:
+                # nothing ready anywhere: block on the control queue —
+                # ring publishes wake it via a nudge message, and the
+                # timeout doubles as a poll backstop, backing off while
+                # idle (a nudge can race the pass that already consumed
+                # its slot, so the poll still matters)
+                try:
+                    msg = self._result_q.get(timeout=idle_wait)
+                except queue.Empty:
+                    if self._closed and not any(
+                        p.is_alive() for p in self._procs
+                    ):
+                        self._pass(eof_set)  # final sweep
+                        break
+                    idle_wait = min(idle_wait * 2, 0.05)
+                else:
+                    self._route_msg(msg, eof_set)
+                    idle_wait = 0.0005
             # liveness must ALSO run under sustained traffic: a busy
-            # surviving worker keeps result_q non-empty, so the idle
+            # surviving worker keeps the ring non-empty, so the idle
             # branch alone could leave a dead worker's acked payloads
             # pinning _inflight for as long as load lasts
             if (
@@ -712,6 +816,477 @@ class MultiProcessIngester:
             ):
                 self._check_liveness(eof_set)
                 last_liveness = time.monotonic()
+
+    def _pass(self, eof_set: set) -> bool:  # zt-dispatch-critical: one drain pass — consume ready slots, flush completed payloads coalesced, free slots
+        """One dispatcher pass: drain the control queue, pump every live
+        stripe's contiguous run of ready slots in wseq order, flush the
+        payloads that completed (coalesced), materialize any view still
+        buffered for an incomplete payload, then free the consumed slots
+        — so no slot is ever held across passes and a multi-chunk
+        payload cannot starve its own worker of ring capacity."""
+        activity = False
+        # zt-lint: disable=ZT09 — per queued control MESSAGE (chunk- or
+        # payload-granular), never per span
+        while True:
+            try:
+                msg = self._result_q.get_nowait()
+            except queue.Empty:
+                break
+            self._route_msg(msg, eof_set)
+            activity = True
+        ready: List[tuple] = []
+        consumed: Dict[int, int] = {}
+        self._pump(ready, consumed)
+        occ = self._ring.occupancy()
+        if occ > self._ring_high:
+            self._ring_high = occ
+        if ready:
+            self._flush_ready(ready)
+        if consumed:
+            self._materialize_views()
+            # zt-lint: disable=ZT09 — per worker STRIPE with consumed slots
+            for w, cnt in consumed.items():
+                for _ in range(cnt):  # zt-lint: disable=ZT09 — per consumed SLOT (chunk-sized), a word store + counter bump each
+                    self._ring.free_next(w)
+            activity = True
+        if self._reap_later and not self._reaping:
+            # zt-lint: disable=ZT09 — per deferred-reap WORKER
+            dead = [w for w in self._reap_later if w not in eof_set]
+            self._reap_later = []
+            if dead:
+                self._reap_dead_workers(dead, eof_set)
+                activity = True
+        # zt-lint: disable=ZT09 — per EOF-pending WORKER, two integer reads
+        for w in list(self._pending_eof):
+            if (
+                self._ring.stripe_depth(w) == 0
+                and not self._holdback[w]
+            ):
+                self._pending_eof.discard(w)
+                eof_set.add(w)
+                activity = True
+        return activity or bool(ready)
+
+    def _route_msg(self, msg, eof_set: set) -> None:
+        """Sort one control-queue message: EOFs resolve now (clean) or
+        mark the worker for reaping (premature); chunk/fallback messages
+        park in the per-worker holdback until their wseq turn."""
+        kind = msg[0]
+        if kind == _KIND_NUDGE:
+            return  # wakeup only — the pump reads the ring directly
+        if kind == _KIND_EOF:
+            widx = msg[1]
+            if self._closed or widx in self._dead:
+                # clean shutdown: finalized once the stripe drains
+                self._pending_eof.add(widx)
+                if widx in self._dead:
+                    self._pending_eof.discard(widx)
+                    eof_set.add(widx)
+            elif self._reaping:
+                self._reap_extra.append(widx)
+            else:
+                # workers only EOF after close()'s None sentinel; an EOF
+                # before close() means the worker loop was torn down by
+                # a BaseException with its inflight payloads unaccounted
+                # — treat it exactly like an unclean death and refeed
+                # (deferred to the pass tail so payloads already
+                # completed in this pass flush before the reap scan)
+                self._reap_later.append(widx)
+            return
+        widx, wseq = msg[1], msg[3]
+        if widx in self._dead:
+            return
+        self._holdback[widx][wseq] = msg
+
+    def _pump(self, ready: List[tuple], consumed: Dict[int, int]) -> None:  # zt-dispatch-critical: in-order merge of ring slots + queue stragglers per worker
+        """Apply every worker's available chunks strictly in wseq order,
+        merging the ring stripe with held-back queue messages. Stops per
+        worker at the first missing sequence (still in flight on the
+        other channel)."""
+        # zt-lint: disable=ZT09 — per WORKER stripe
+        for w in range(self.workers):
+            if w in self._dead:
+                continue
+            budget = self._ring.stripe_slots + len(self._holdback[w]) + 1
+            while budget > 0:  # zt-lint: disable=ZT09 — bounded by stripe depth + holdback, each iteration applies one chunk
+                budget -= 1
+                exp = self._expected[w]
+                hb = self._holdback[w].pop(exp, None)
+                if hb is not None:
+                    self._apply_queue_msg(hb, ready)
+                    self._expected[w] = exp + 1
+                    continue
+                peeked = self._ring.peek(w, consumed.get(w, 0))
+                if peeked is None:
+                    break
+                hdr, seq = peeked
+                if int(hdr[ring_mod._S_WSEQ]) != exp:
+                    break  # the missing wseq is in flight on the queue
+                self._consume_ring_chunk(w, hdr, seq, ready)
+                consumed[w] = consumed.get(w, 0) + 1
+                self._expected[w] = exp + 1
+
+    def _consume_ring_chunk(
+        self, w: int, hdr: np.ndarray, seq: int, ready: List[tuple]
+    ) -> None:  # zt-dispatch-critical: zero-copy slot consume — header decode + vocab replay, no image copy
+        t0 = time.perf_counter()
+        pid = int(hdr[ring_mod._S_PIDX])
+        if pid not in self._pending:
+            # late chunk of a payload a reap already refed: discard (the
+            # slot is still counted consumed and freed by the pass)
+            self.counters["ringDiscarded"] += 1
+            return
+        per = int(hdr[ring_mod._S_PER])
+        fused = self._ring.image(
+            w, seq, self._n_shards * self._wire_rows * per
+        ).reshape(self._n_shards, self._wire_rows, per)
+        aux_len = int(hdr[ring_mod._S_AUX_LEN])
+        svc_new, name_new, pairs_new, arch, rec = ring_mod.unpack_aux(
+            self._ring.aux(w, seq, aux_len)
+        )
+        self._apply_chunk(
+            w, pid, fused,
+            int(hdr[ring_mod._S_NSPANS]), int(hdr[ring_mod._S_NDUR]),
+            int(hdr[ring_mod._S_NERR]), int(hdr[ring_mod._S_DROPPED]),
+            svc_new, name_new, pairs_new, arch,
+            (int(hdr[ring_mod._S_TS_MIN]), int(hdr[ring_mod._S_TS_MAX])),
+            rec,
+            int(hdr[ring_mod._S_PARSE_NS]) / 1e9,
+            int(hdr[ring_mod._S_PACK_NS]) / 1e9,
+            int(hdr[ring_mod._S_ROUTE_NS]) / 1e9,
+            True, time.perf_counter() - t0, ready,
+        )
+
+    def _apply_queue_msg(self, msg, ready: List[tuple]) -> None:
+        kind = msg[0]
+        if kind == _KIND_FALLBACK:
+            _, widx, pid, _wseq = msg
+            payload = self._pending.get(pid)
+            if payload is None:
+                return  # a reap already refed it
+            self._buffered.pop(pid, None)
+            self._drop_cslot(pid)  # slow-path retry: timeline abandoned
+            self._fallback(payload)
+            self.counters["fallbacks"] += 1
+            if 0 <= widx < len(self._wstats):
+                self._wstats[widx]["fallbacks"] += 1
+            self._finish(pid)
+            return
+        (
+            _, widx, pid, _wseq, fused, n_spans, n_dur, n_err, dropped,
+            svc_new, name_new, pairs_new, arch, ts_range, rec,
+            parse_s, pack_s, route_s,
+        ) = msg
+        t0 = time.perf_counter()
+        if pid not in self._pending:
+            return
+        self._apply_chunk(
+            widx, pid, fused, n_spans, n_dur, n_err, dropped,
+            svc_new, name_new, pairs_new, arch, ts_range, rec,
+            parse_s, pack_s, route_s,
+            False, time.perf_counter() - t0, ready,
+        )
+
+    def _apply_chunk(
+        self, widx, pid, fused, n_spans, n_dur, n_err, dropped,
+        svc_new, name_new, pairs_new, arch, ts_range, rec,
+        parse_s, pack_s, route_s, is_view, consume_s, ready,
+    ) -> None:  # zt-dispatch-critical: per-chunk apply — vocab journal replay + buffer append on the single dispatch thread
+        store = self.store
+        vocab = store.vocab
+        m = self._maps[widx]
+        cs = self._cslots.get(pid, -1) if self._cp_ledger is not None else -1
+        if svc_new or name_new or pairs_new:
+            tv0 = time.perf_counter()
+            with store._intern_lock:
+                # zt-lint: disable=ZT09 — journal replay is per NEWLY
+                # INTERNED STRING (bounded by vocab capacity, amortized
+                # zero per span), not per span
+                m.svc = _IdMaps._append(
+                    m.svc, [vocab.services.intern(s) for s in svc_new]
+                )
+                # zt-lint: disable=ZT09 — per new string, as above
+                m.name = _IdMaps._append(
+                    m.name, [vocab.span_names.intern(s) for s in name_new]
+                )
+                # zt-lint: disable=ZT09 — per new (svc, name) pair
+                m.key = _IdMaps._append(
+                    m.key,
+                    [
+                        vocab.key_id(int(m.svc[sl]), int(m.name[nl]))
+                        for sl, nl in pairs_new
+                    ],
+                )
+            tv1 = time.perf_counter()
+            obs.record("mp_vocab_replay", tv1 - tv0)
+            if cs >= 0:
+                self._cp_ledger.stamp(
+                    cs, _critpath.SEG_VOCAB_REPLAY,
+                    int(tv0 * 1e9), int(tv1 * 1e9), pid,
+                )
+        # worker-measured stage wall time: the workers can't touch the
+        # in-process flight recorder, so their parse/pack/route timings
+        # ride the chunk and are recorded here. record_relayed
+        # (histogram-only): the time was spent in a worker process, so a
+        # budget crossing must not emit a self-span B3-linked to
+        # whatever request context this dispatcher thread holds.
+        if parse_s > 0.0:
+            obs.record_relayed("parse", parse_s)
+        if pack_s > 0.0:
+            obs.record_relayed("pack", pack_s)
+        if route_s > 0.0:
+            obs.record_relayed("route", route_s)
+        ws = self._wstats[widx]
+        ws["chunks"] += 1
+        ws["spans"] += n_spans
+        ws["parseUs"] += int(parse_s * 1e6 + 0.5)
+        ws["packUs"] += int(pack_s * 1e6 + 0.5)
+        ws["routeUs"] += int(route_s * 1e6 + 0.5)
+        if dropped >= 0:
+            ws["payloads"] += 1
+        if fused is not None:
+            if rec is not None:
+                # remap the record's svc/rsvc/name/key lanes local ->
+                # global NOW (the journal above covers every id this
+                # chunk references; the maps may have grown by apply
+                # time); append is deferred to the completion flush
+                rec = list(rec)
+                rec[7] = m.svc[rec[7]]
+                rec[8] = m.svc[rec[8]]
+                rec[9] = m.name[rec[9]]
+                rec[10] = m.key[rec[10]]
+                rec = tuple(rec)
+            self._buffered.setdefault(pid, []).append(
+                [fused, n_spans, n_dur, n_err, ts_range, arch, rec,
+                 consume_s, is_view, widx]
+            )
+        # dropped == -1 marks a continuation chunk; the payload is
+        # applied atomically once its LAST chunk has been consumed
+        if dropped >= 0:
+            ready.append((pid, dropped))
+
+    def _materialize_views(self) -> None:
+        """Chunks still buffered for an INCOMPLETE payload at pass end
+        get copied out of their ring slots (the pre-ring per-chunk copy,
+        now paid only by payloads that straddle a pass) so every
+        consumed slot can be freed — a payload can never pin its
+        worker's stripe while waiting for its own later chunks."""
+        for pid, entries in self._buffered.items():
+            for e in entries:
+                if not e[8]:
+                    continue
+                t0 = time.perf_counter()
+                e[0] = np.array(e[0])
+                e[8] = False
+                tc1 = time.perf_counter()
+                obs.record("mp_shm_copy", tc1 - t0)
+                cs = (
+                    self._cslots.get(pid, -1)
+                    if self._cp_ledger is not None else -1
+                )
+                if cs >= 0:
+                    self._cp_ledger.stamp(
+                        cs, _critpath.SEG_SHM_COPY,
+                        int(t0 * 1e9), int(tc1 * 1e9), pid,
+                    )
+
+    # -- coalesced flush --------------------------------------------------
+
+    def _flush_ready(self, ready: List[tuple]) -> None:  # zt-dispatch-critical: applies completed payloads to the device + durability path, coalesced
+        """Flush the payloads completed this pass: their buffered chunks
+        are packed into groups of up to ``coalesce_max`` chunks (bounded
+        by the aggregator's lane cap) and each group takes ONE
+        ``ingest_fused_multi`` — whose dispatch side carries the WAL
+        append and sampling verdicts, preserving ack-after-durability
+        exactly like the serial path. Until this runs, a payload has
+        mutated nothing, which is what makes worker death recoverable.
+        A payload's chunks may split across groups (the same
+        at-least-once boundary the per-chunk path always had); its ack
+        fires only after the group holding its last chunk — and, when
+        several groups share one vectored WAL commit, after that commit.
+        """
+        store = self.store
+        plans: Dict[int, dict] = {}
+        flat: List[tuple] = []
+        # zt-lint: disable=ZT09 — per completed PAYLOAD
+        for pid, dropped in ready:
+            entries = self._buffered.pop(pid, [])
+            # zt-lint: disable=ZT09 — per buffered CHUNK of one payload
+            plans[pid] = {
+                "dropped": dropped,
+                "left": len(entries),
+                "spans": sum(e[1] for e in entries),
+                "consume_s": sum(e[7] for e in entries),
+            }
+            # zt-lint: disable=ZT09 — per buffered CHUNK, a list append
+            for e in entries:
+                flat.append((e, pid))
+        cap = store.agg.lane_cap
+        groups: List[List[tuple]] = []
+        cur: List[tuple] = []
+        lanes = 0
+        for e, pid in flat:  # zt-lint: disable=ZT09 — per chunk: greedy group packing, integer bookkeeping only
+            per = int(e[0].shape[-1])
+            if cur and (
+                len(cur) >= self.coalesce_max or lanes + per > cap
+            ):
+                groups.append(cur)
+                cur, lanes = [], 0
+            cur.append((e, pid))
+            lanes += per
+        if cur:
+            groups.append(cur)
+        wal = getattr(store, "wal", None)
+        if wal is not None and len(groups) > 1:
+            # one vectored WAL commit for the whole pass: per-record
+            # flush/fsync deferred, every group's ack deferred past the
+            # commit so ack-after-durability still holds
+            done: List[int] = []
+            with wal.batched():
+                for g in groups:  # zt-lint: disable=ZT09 — per coalesced GROUP (one device step each)
+                    done.extend(self._flush_group(g, plans))
+            self._ack_done(done, plans)
+        else:
+            for g in groups:  # zt-lint: disable=ZT09 — per coalesced GROUP (one device step each)
+                self._ack_done(self._flush_group(g, plans), plans)
+        # payloads with no device chunks at all (every span boundary-
+        # sampled away, or an empty payload): nothing to group, ack now
+        # zt-lint: disable=ZT09 — per completed PAYLOAD, dict reads only
+        empty = [
+            pid for pid, p in plans.items()
+            if p["left"] == 0 and not p.get("acked")
+        ]
+        if empty:
+            self._ack_done(empty, plans)
+
+    def _flush_group(self, group: List[tuple], plans: Dict[int, dict]) -> List[int]:  # zt-dispatch-critical: one coalesced group -> one remap+step+WAL record
+        store = self.store
+        led = self._cp_ledger
+        t_g0 = time.perf_counter()
+        pairs = []
+        if led is not None:
+            seen: Set[int] = set()
+            for _, pid in group:  # zt-lint: disable=ZT09 — per group member, set lookups only
+                if pid not in seen:
+                    seen.add(pid)
+                    pairs.append((self._cslots.get(pid, -1), pid))
+            # zt-lint: disable=ZT09 — per traced group MEMBER
+            traced = [(s, p) for s, p in pairs if s >= 0]
+            if len(traced) == 1:
+                # arm the thread-local so wal.py's append/fsync stamps
+                # land in this payload's timeline (WAL rides the step)
+                _critpath.set_active(led, traced[0][0], traced[0][1])
+            elif traced:
+                _critpath.set_active_group(led, traced)
+        n_spans = n_dur = n_err = 0
+        lo = hi = None
+        parts = []
+        for e, pid in group:  # zt-lint: disable=ZT09 — per CHUNK (max_batch-sized); all per-span work inside is vectorized
+            fused, c_spans, c_dur, c_err, ts_range, arch, rec, _c, is_view, widx = e
+            if arch:
+                self._archive(arch)
+            if rec is not None and getattr(store, "_disk", None) is not None:
+                # sampling gate: the fused sketch feed below always sees
+                # 100% of spans; only raw-archive retention is gated.
+                # Gating happens here (not in disk_append_record) so the
+                # sync fast path is not double-gated, and at flush time
+                # so verdicts see the same publish state as the serial
+                # path's dispatch-ordered gate.
+                sampler = store.agg.sampler
+                if sampler is not None:
+                    rec = sampler.gate_record(rec)
+                if rec is not None:
+                    store.disk_append_record(rec)
+            if self.shadow is not None:
+                # the tap may retain its argument: never hand it a live
+                # ring-slot view
+                self.shadow.offer_fused(
+                    np.array(fused) if is_view else fused
+                )
+            m = self._maps[widx]
+            parts.append((fused, m.svc, m.key))
+            n_spans += c_spans
+            n_dur += c_dur
+            n_err += c_err
+            if c_spans > 0:
+                lo = ts_range[0] if lo is None else min(lo, ts_range[0])
+                hi = ts_range[1] if hi is None else max(hi, ts_range[1])
+        if len(group) == 1:
+            ts = group[0][0][4]  # the chunk's own range, bit-for-bit
+        else:
+            ts = (lo, hi) if lo is not None else (0, 0)
+        tf0 = time.perf_counter()
+        # resource-fault injection (faults.py, ISSUE 13): an armed
+        # feed.latency site sleeps here — the exact seam where a slow
+        # device feed stalls the dispatcher — so overload tests can
+        # manufacture queue saturation deterministically
+        faults.resource_point("feed.latency")
+        store.agg.ingest_fused_multi(
+            parts, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
+            ts_range=ts, pad_to_multiple=store._pad,
+        )
+        tf1 = time.perf_counter()
+        obs.record("mp_device_feed", tf1 - tf0)
+        if led is not None:
+            for s, p in pairs:  # zt-lint: disable=ZT09 — per traced group member, 3 word stores each
+                if s >= 0:
+                    led.stamp(
+                        s, _critpath.SEG_DEVICE_FEED,
+                        int(tf0 * 1e9), int(tf1 * 1e9), p,
+                    )
+            _critpath.clear_active()
+        if len(group) > 1:
+            self.counters["coalescedBatches"] += 1
+            self.counters["coalescedChunks"] += len(group)
+        # apportion this group's flush wall across its chunks by span
+        # weight, so mp_record stays a PER-CHUNK handling time (consume
+        # + attributable flush share) like the pre-ring tier's stage —
+        # not the whole pass wall billed to every payload in it
+        g_wall = time.perf_counter() - t_g0
+        # zt-lint: disable=ZT09 — per group MEMBER (bounded by
+        # coalesce_max), integer header reads only
+        g_spans = sum(e[1] for e, _ in group) or len(group)
+        done = []
+        for e, pid in group:  # zt-lint: disable=ZT09 — per group member, dict bookkeeping only
+            p = plans[pid]
+            p["flush_s"] = p.get("flush_s", 0.0) + g_wall * (
+                (e[1] or 1) / g_spans
+            )
+            p["left"] -= 1
+            if p["left"] == 0:
+                done.append(pid)
+        return done
+
+    def _ack_done(self, pids: List[int], plans: Dict[int, dict]) -> None:
+        """Ack payloads whose last chunk is durable: counters, metrics,
+        ledger ack, inflight release. Runs after the group flush — and
+        after the vectored WAL commit when one covered the pass."""
+        for pid in pids:  # zt-lint: disable=ZT09 — per completed PAYLOAD, counter updates only
+            p = plans[pid]
+            if p.get("acked"):
+                continue
+            p["acked"] = True
+            total = p["spans"]
+            dropped = p["dropped"]
+            obs.record(
+                "mp_record", p["consume_s"] + p.get("flush_s", 0.0)
+            )
+            self.counters["accepted"] += total
+            self.counters["sampleDropped"] += max(dropped, 0)
+            if self.metrics is not None:
+                self.metrics.increment_spans(total + max(dropped, 0))
+                if dropped > 0:
+                    self.metrics.increment_spans_dropped(dropped)
+            cs = (
+                self._cslots.get(pid, -1)
+                if self._cp_ledger is not None else -1
+            )
+            if cs >= 0:
+                # durable ack: the WAL append + device feed completed
+                self._cp_ledger.ack(cs, pid)
+            self._finish(pid)
+
+    # -- worker death -----------------------------------------------------
 
     def _check_liveness(self, eof_set: set) -> None:
         """A worker that died uncleanly (segfault in the native parser,
@@ -731,13 +1306,14 @@ class MultiProcessIngester:
         pool serving on the survivors: because chunk application is
         buffered until a payload's completion marker, a half-processed
         payload has mutated no store state — its buffered chunks are
-        discarded and the whole payload (plus everything queued behind
-        it) re-ingests on the slow path. Zero acked-span loss, no
-        double-ingest, and the dead worker's _IdMaps / inflight
-        accounting are released (the leak the r8 satellite named).
-        Re-entrancy: draining result_q below can discover ANOTHER
-        premature EOF — those fold into THIS reap via _reap_extra
-        rather than recursing (ADVICE r4)."""
+        discarded, its ring stripe reclaimed (the pid-guarded torn-slot
+        reset handles a SIGKILL mid-write), and the whole payload (plus
+        everything queued behind it) re-ingests on the slow path. Zero
+        acked-span loss, no double-ingest, and the dead worker's
+        _IdMaps / inflight accounting are released. Re-entrancy:
+        draining below can discover ANOTHER premature EOF — those fold
+        into THIS reap via _reap_extra rather than recursing (ADVICE
+        r4)."""
         self._reaping = True
         try:
             # mark dead under _cv FIRST: submit() registers under the
@@ -750,12 +1326,25 @@ class MultiProcessIngester:
             # through a feeder thread, so a just-shipped result can be
             # in the pipe but not yet visible — get_nowait() would miss
             # chunks a surviving worker already produced
-            while True:  # apply results already produced (any worker)
+            while True:
                 try:
                     msg = self._result_q.get(timeout=0.25)
                 except queue.Empty:
                     break
-                self._handle_msg(msg, eof_set)
+                self._route_msg(msg, eof_set)
+            # apply + FLUSH everything already produced (survivors, and
+            # any payload the dead workers fully published before
+            # dying): completed payloads leave _pending before the
+            # refeed scan, so they cannot double-ingest
+            ready: List[tuple] = []
+            consumed: Dict[int, int] = {}
+            self._pump(ready, consumed)
+            if ready:
+                self._flush_ready(ready)
+            self._materialize_views()
+            for w, cnt in consumed.items():
+                for _ in range(cnt):
+                    self._ring.free_next(w)
             if self._reap_extra:
                 with self._cv:
                     self._dead.update(self._reap_extra)
@@ -764,7 +1353,14 @@ class MultiProcessIngester:
             refed = 0
             for w in dead:
                 eof_set.add(w)
+                self._pending_eof.discard(w)
                 self._maps[w] = None  # free the dead worker's id tables
+                self._holdback[w].clear()
+                rec = self._ring.reclaim_stripe(
+                    w, self._procs[w].pid or -1
+                )
+                self.counters["ringDiscarded"] += rec["discarded"]
+                self.counters["ringTorn"] += rec["torn"]
                 # empty its queue so the feeder thread can't block
                 # shutdown; the payloads themselves re-ingest via the
                 # _assigned scan (they are all still in _pending)
@@ -799,212 +1395,7 @@ class MultiProcessIngester:
             dead, refed, self.workers - len(self._dead),
         )
 
-    def _handle_msg(self, msg, eof_set: set) -> None:  # zt-dispatch-critical: single thread between N workers and the device
-        store = self.store
-        vocab = store.vocab
-        kind = msg[0]
-        if kind == _KIND_EOF:
-            eof_set.add(msg[1])
-            if not self._closed:
-                # workers only EOF after close()'s None sentinel; an EOF
-                # before close() means the worker loop was torn down by
-                # a BaseException (KeyboardInterrupt, a failing
-                # work_q.get) with its inflight payloads unaccounted —
-                # treat it exactly like an unclean death and refeed
-                if self._reaping:
-                    self._reap_extra.append(msg[1])
-                else:
-                    self._reap_dead_workers([msg[1]], eof_set)
-            return
-        if kind == _KIND_FALLBACK:
-            _, widx, pid = msg
-            payload = self._pending.get(pid)
-            if payload is None:
-                return  # a reap already refed it
-            self._buffered.pop(pid, None)
-            self._drop_cslot(pid)  # slow-path retry: timeline abandoned
-            self._fallback(payload)
-            self.counters["fallbacks"] += 1
-            if 0 <= widx < len(self._wstats):
-                self._wstats[widx]["fallbacks"] += 1
-            self._finish(pid)
-            return
-        (
-            _, widx, pid, slot, shape, n_spans, n_dur, n_err, dropped,
-            svc_new, name_new, pairs_new, arch, ts_range, rec,
-            parse_s, pack_s, route_s,
-        ) = msg
-        if widx in self._dead or pid not in self._pending:
-            # late chunk from a reaped worker (its payload already
-            # re-ingested on the slow path): only the slot needs freeing
-            if slot is not None:
-                self._sems[widx].release()
-            return
-        m = self._maps[widx]
-        cs = self._cslots.get(pid, -1) if self._cp_ledger is not None else -1
-        if svc_new or name_new or pairs_new:
-            tv0 = time.perf_counter()
-            with store._intern_lock:
-                # zt-lint: disable=ZT09 — journal replay is per NEWLY
-                # INTERNED STRING (bounded by vocab capacity, amortized
-                # zero per span), not per span
-                m.svc = _IdMaps._append(
-                    m.svc, [vocab.services.intern(s) for s in svc_new]
-                )
-                # zt-lint: disable=ZT09 — per new string, as above
-                m.name = _IdMaps._append(
-                    m.name, [vocab.span_names.intern(s) for s in name_new]
-                )
-                # zt-lint: disable=ZT09 — per new (svc, name) pair
-                m.key = _IdMaps._append(
-                    m.key,
-                    [
-                        vocab.key_id(int(m.svc[sl]), int(m.name[nl]))
-                        for sl, nl in pairs_new
-                    ],
-                )
-            tv1 = time.perf_counter()
-            obs.record("mp_vocab_replay", tv1 - tv0)
-            if cs >= 0:
-                self._cp_ledger.stamp(
-                    cs, _critpath.SEG_VOCAB_REPLAY,
-                    int(tv0 * 1e9), int(tv1 * 1e9), pid,
-                )
-        # worker-measured stage wall time: the workers can't touch the
-        # in-process flight recorder, so their parse/pack/route timings
-        # ride the batch message and are recorded here. record_relayed
-        # (histogram-only): the time was spent in a worker process, so a
-        # budget crossing must not emit a self-span B3-linked to
-        # whatever request context this dispatcher thread holds.
-        if parse_s > 0.0:
-            obs.record_relayed("parse", parse_s)
-        if pack_s > 0.0:
-            obs.record_relayed("pack", pack_s)
-        if route_s > 0.0:
-            obs.record_relayed("route", route_s)
-        ws = self._wstats[widx]
-        ws["chunks"] += 1
-        ws["spans"] += n_spans
-        ws["parseUs"] += int(parse_s * 1e6 + 0.5)
-        ws["packUs"] += int(pack_s * 1e6 + 0.5)
-        ws["routeUs"] += int(route_s * 1e6 + 0.5)
-        if dropped >= 0:
-            ws["payloads"] += 1
-        if slot is not None:
-            t0 = time.perf_counter()
-            size = int(np.prod(shape))
-            src = np.frombuffer(
-                self._shm.buf, np.uint32, count=size,
-                offset=widx * self._slots_per_worker * self._slot_bytes
-                + slot * self._slot_bytes,
-            )
-            fused = src.reshape(shape).copy()
-            self._sems[widx].release()  # slot free the moment we copied
-            tc1 = time.perf_counter()
-            obs.record("mp_shm_copy", tc1 - t0)
-            if cs >= 0:
-                self._cp_ledger.stamp(
-                    cs, _critpath.SEG_SHM_COPY,
-                    int(t0 * 1e9), int(tc1 * 1e9), pid,
-                )
-            from zipkin_tpu.tpu.columnar import remap_fused
-
-            remap_fused(fused, m.svc, m.key)
-            tr1 = time.perf_counter()
-            obs.record("mp_lut_remap", tr1 - tc1)
-            if cs >= 0:
-                self._cp_ledger.stamp(
-                    cs, _critpath.SEG_LUT_REMAP,
-                    int(tc1 * 1e9), int(tr1 * 1e9), pid,
-                )
-            if rec is not None:
-                # remap the record's svc/rsvc/name/key lanes local ->
-                # global NOW (the journal above covers every id this
-                # chunk references; the maps may have grown by apply
-                # time); append is deferred to the completion flush
-                rec = list(rec)
-                rec[7] = m.svc[rec[7]]
-                rec[8] = m.svc[rec[8]]
-                rec[9] = m.name[rec[9]]
-                rec[10] = m.key[rec[10]]
-                rec = tuple(rec)
-            self._buffered.setdefault(pid, []).append(
-                (fused, n_spans, n_dur, n_err, ts_range, arch, rec,
-                 time.perf_counter() - t0)
-            )
-        # dropped == -1 marks a continuation chunk; the payload is
-        # applied atomically on its LAST chunk's message
-        if dropped >= 0:
-            self._flush_payload(pid, dropped)
-
-    def _flush_payload(self, pid: int, dropped: int) -> None:  # zt-dispatch-critical: applies a completed payload to the device + durability path
-        """Apply a completed payload's buffered chunks: RAM/disk archive,
-        then ingest_fused — whose dispatch side carries the WAL append
-        and sampling verdicts, preserving ack-after-durability exactly
-        like the serial path. Until this runs, the payload has mutated
-        nothing, which is what makes worker death recoverable."""
-        store = self.store
-        total = 0
-        t0 = time.perf_counter()
-        copy_s = 0.0
-        cs = self._cslots.get(pid, -1) if self._cp_ledger is not None else -1
-        if cs >= 0:
-            # arm the thread-local so wal.py's append/fsync stamps land
-            # in this payload's timeline (the WAL rides ingest_fused)
-            _critpath.set_active(self._cp_ledger, cs, pid)
-        # zt-lint: disable=ZT09 — per CHUNK (max_batch-sized), not per
-        # span; all per-span work inside is vectorized
-        for fused, n_spans, n_dur, n_err, ts_range, arch, rec, c_s in (
-            self._buffered.pop(pid, ())
-        ):
-            copy_s += c_s
-            if arch:
-                self._archive(arch)
-            if rec is not None and getattr(store, "_disk", None) is not None:
-                # sampling gate: the fused sketch feed below always sees
-                # 100% of spans; only raw-archive retention is gated.
-                # Gating happens here (not in disk_append_record) so the
-                # sync fast path is not double-gated, and at flush time
-                # so verdicts see the same publish state as the serial
-                # path's dispatch-ordered gate.
-                sampler = store.agg.sampler
-                if sampler is not None:
-                    rec = sampler.gate_record(rec)
-                if rec is not None:
-                    store.disk_append_record(rec)
-            if self.shadow is not None:
-                self.shadow.offer_fused(fused)
-            tf0 = time.perf_counter()
-            # resource-fault injection (faults.py, ISSUE 13): an armed
-            # feed.latency site sleeps here — the exact seam where a
-            # slow device feed stalls the dispatcher — so overload
-            # tests can manufacture queue saturation deterministically
-            faults.resource_point("feed.latency")
-            store.agg.ingest_fused(
-                fused, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
-                ts_range=ts_range,
-            )
-            tf1 = time.perf_counter()
-            obs.record("mp_device_feed", tf1 - tf0)
-            if cs >= 0:
-                self._cp_ledger.stamp(
-                    cs, _critpath.SEG_DEVICE_FEED,
-                    int(tf0 * 1e9), int(tf1 * 1e9), pid,
-                )
-            total += n_spans
-        if cs >= 0:
-            _critpath.clear_active()
-        obs.record("mp_record", copy_s + (time.perf_counter() - t0))
-        self.counters["accepted"] += total
-        self.counters["sampleDropped"] += max(dropped, 0)
-        if self.metrics is not None:
-            self.metrics.increment_spans(total + max(dropped, 0))
-            if dropped > 0:
-                self.metrics.increment_spans_dropped(dropped)
-        if cs >= 0:
-            # durable ack: the WAL append + device feed above completed
-            self._cp_ledger.ack(cs, pid)
-        self._finish(pid)
+    # -- shared helpers ----------------------------------------------------
 
     def _drop_cslot(self, pid: int) -> None:
         """Abandon a payload's timeline (fallback/reap path): partial

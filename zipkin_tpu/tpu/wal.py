@@ -29,6 +29,7 @@ parity after crash+replay is asserted in tests/test_wal.py.
 
 from __future__ import annotations
 
+import contextlib
 import errno
 import json
 import logging
@@ -66,6 +67,7 @@ class WriteAheadLog:
         self._seg_idx = 0
         self._seq = 0
         self._closed = False
+        self._batch_depth = 0  # >0 inside batched(): defer flush/fsync
         # disk-exhaustion degraded mode (ISSUE 13): an ENOSPC append
         # does NOT crash the ingest path — the record is missed, the log
         # flags itself at-risk (acked spans between here and the next
@@ -98,7 +100,13 @@ class WriteAheadLog:
             raise RuntimeError("WAL is closed")
         t0 = time.perf_counter()
         self._seq += 1
-        payload = np.ascontiguousarray(fused, np.uint32).tobytes()
+        # memoryview, not tobytes(): the image is already contiguous u32
+        # (or made so here) and BufferedWriter/crc32 both consume the
+        # buffer protocol, so the record costs zero payload copies
+        # (cast() refuses views with a zero in the shape, so empty
+        # images — flush markers — take the literal-bytes branch)
+        arr = np.ascontiguousarray(fused, np.uint32)
+        payload = arr.data.cast("B") if arr.size else memoryview(b"")
         meta = dict(meta, shape=list(fused.shape))
         meta_b = json.dumps(meta, separators=(",", ":")).encode()
         head = _HEADER.pack(
@@ -106,6 +114,7 @@ class WriteAheadLog:
             zlib.crc32(payload),
         )
         rec_len = len(head) + len(meta_b) + len(payload)
+        deferred = self._batch_depth > 0
         try:
             faults.resource_point("wal.append")
             fh = self._file_for(rec_len)
@@ -120,7 +129,8 @@ class WriteAheadLog:
                 # same on-disk state a SIGKILL after a real flush would
             faults.crashpoint("wal.append.mid")
             fh.write(payload)
-            fh.flush()
+            if not deferred:
+                fh.flush()
             faults.crashpoint("wal.append.pre_fsync")
             t1 = time.perf_counter()
             # the critical-path ledger wants append and fsync as
@@ -130,7 +140,7 @@ class WriteAheadLog:
             critpath.stamp_active(
                 critpath.SEG_WAL_APPEND, int(t0 * 1e9), int(t1 * 1e9)
             )
-            if self.fsync:
+            if self.fsync and not deferred:
                 os.fsync(fh.fileno())
                 t2 = time.perf_counter()
                 obs.record("wal_fsync", t2 - t1)
@@ -144,6 +154,10 @@ class WriteAheadLog:
             return self._seq
         # bit-rot injection site (ISSUE 7): the record's payload bytes
         # are durable — damage them at rest; the process keeps running
+        # (a deferred append must land on disk first for rot to have
+        # bytes to chew on)
+        if deferred and faults.is_corrupt_armed("wal.record"):
+            fh.flush()
         faults.corrupt_point(
             "wal.record", self._path,
             self._fh_bytes + _HEADER.size + len(meta_b), len(payload),
@@ -151,6 +165,46 @@ class WriteAheadLog:
         self._fh_bytes += rec_len
         obs.record("wal_append", time.perf_counter() - t0)
         return self._seq
+
+    @contextlib.contextmanager
+    def batched(self):
+        """Vectored append: records appended inside this context defer
+        the per-record flush/fsync, and exiting commits the whole run
+        with ONE flush (+ one fsync when enabled) — the span-ring
+        dispatcher's multi-group flush pass amortizes its durability
+        syscalls this way. Record FORMAT is untouched (each append still
+        writes its own header/meta/payload/crc), so ``records()``/
+        ``replay()`` cannot tell a batched run from serial appends; only
+        the ack must wait for the commit, which the dispatcher does.
+        ``wal.append.mid`` keeps its armed-flush semantics per record."""
+        if self._closed:
+            raise RuntimeError("WAL is closed")
+        self._batch_depth += 1
+        try:
+            yield self
+        finally:
+            self._batch_depth -= 1
+            if self._batch_depth == 0:
+                self._commit_batch()
+
+    def _commit_batch(self) -> None:
+        fh = self._fh
+        if fh is None:
+            return
+        t1 = time.perf_counter()
+        try:
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+                t2 = time.perf_counter()
+                obs.record("wal_fsync", t2 - t1)
+                critpath.stamp_active(
+                    critpath.SEG_WAL_FSYNC, int(t1 * 1e9), int(t2 * 1e9)
+                )
+        except OSError as e:
+            if e.errno != errno.ENOSPC:
+                raise
+            self._note_enospc()
 
     def _note_enospc(self) -> None:
         """Disk full mid-append: the record is lost (it gets a seq but
