@@ -1380,3 +1380,426 @@ def test_zt09_coalesce_gather_shape(tmp_path):
     )
     assert rules(result) == []
     assert len(result.suppressed) == 1
+
+
+# -- multi-file helper (the interprocedural rules need >1 module) --------
+
+
+def lint_tree(tmp_path, files, **kwargs):
+    """Write a dict of {rel path: source} and lint the whole tree —
+    the shape the whole-program rules (ZT11–ZT13, cross-module ZT07/
+    ZT08) are exercised in."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_paths([str(tmp_path)], root=tmp_path, **kwargs)
+
+
+# -- ZT11: shm seqlock discipline ----------------------------------------
+
+
+ZT11_TORN = """
+    import numpy as np
+
+    _S_GEN = 0
+    _S_TS0 = 1
+
+    class Ring:
+        def publish(self, hdr, ts):
+            hdr[_S_TS0] = ts
+"""
+
+
+def test_zt11_flags_unstamped_protected_write(tmp_path):
+    # the injected torn-write shape: a protected slot-header word
+    # stored with NO generation stamp anywhere in the writer
+    result = lint(tmp_path, ZT11_TORN, name="zipkin_tpu/tpu/ring.py")
+    assert rules(result) == ["ZT11"]
+    assert_rule_owned(
+        tmp_path, ZT11_TORN, "ZT11", name="zipkin_tpu/tpu/ring.py"
+    )
+
+
+def test_zt11_clean_bracketed_write(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        _S_GEN = 0
+        _S_TS0 = 1
+
+        class Ring:
+            def publish(self, hdr, ts):
+                hdr[_S_GEN] += 1
+                hdr[_S_TS0] = ts
+                hdr[_S_GEN] += 1
+        """,
+        name="zipkin_tpu/tpu/ring.py",
+    )
+    assert rules(result) == []
+
+
+def test_zt11_flags_write_outside_bracket(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        _S_GEN = 0
+        _S_TS0 = 1
+        _S_DUR = 2
+
+        class Ring:
+            def publish(self, hdr, ts, dur):
+                hdr[_S_GEN] += 1
+                hdr[_S_TS0] = ts
+                hdr[_S_GEN] += 1
+                hdr[_S_DUR] = dur
+        """,
+        name="zipkin_tpu/tpu/ring.py",
+    )
+    assert rules(result) == ["ZT11"]
+    assert "outside" in result.findings[0].message
+
+
+def test_zt11_flags_single_gen_read_reader(tmp_path):
+    # a gen-aware reader that reads the generation ONCE copied a
+    # possibly-torn payload and never noticed
+    result = lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        _S_GEN = 0
+        _S_TS0 = 1
+
+        class Ring:
+            def peek(self, hdr):
+                g = hdr[_S_GEN]
+                return hdr[_S_TS0]
+        """,
+        name="zipkin_tpu/tpu/ring.py",
+    )
+    assert rules(result) == ["ZT11"]
+
+
+def test_zt11_clean_retry_reader_and_other_modules(tmp_path):
+    # the retry idiom (read gen, copy, re-read gen) is the sanctioned
+    # reader; and the same torn write OUTSIDE a registered region is
+    # not ZT11's business
+    result = lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        _S_GEN = 0
+        _S_TS0 = 1
+
+        class Ring:
+            def peek(self, hdr):
+                g0 = hdr[_S_GEN]
+                v = hdr[_S_TS0]
+                g1 = hdr[_S_GEN]
+                return v if g0 == g1 else None
+        """,
+        name="zipkin_tpu/tpu/ring.py",
+    )
+    assert rules(result) == []
+    assert rules(lint(tmp_path, ZT11_TORN, name="other/mod.py")) == []
+
+
+def test_zt11_cross_function_bracket_via_callers(tmp_path):
+    # the ring's try_claim/publish split: the writer stamps ZERO times
+    # but every in-graph caller brackets the call — the graph proof
+    # replaces a pragma
+    result = lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        _S_GEN = 0
+        _S_TS0 = 1
+
+        class Ring:
+            def _fill(self, hdr, ts):
+                hdr[_S_TS0] = ts
+
+            def publish(self, hdr, ts):
+                hdr[_S_GEN] += 1
+                self._fill(hdr, ts)
+                hdr[_S_GEN] += 1
+        """,
+        name="zipkin_tpu/tpu/ring.py",
+    )
+    assert rules(result) == []
+
+
+# -- ZT12: durability commit chokepoints ---------------------------------
+
+
+ZT12_BARE_RENAME = """
+    import os
+
+    def commit(path, blob):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+"""
+
+
+def test_zt12_flags_fsyncless_rename(tmp_path):
+    # the injected shape: tmp-write + rename with no fsync on either
+    # side — exactly ZT12's finding (pre- and post-rename halves)
+    result = lint(tmp_path, ZT12_BARE_RENAME, name="zipkin_tpu/tpu/wal.py")
+    assert set(rules(result)) == {"ZT12"}
+    assert_rule_owned(
+        tmp_path, ZT12_BARE_RENAME, "ZT12", name="zipkin_tpu/tpu/wal.py"
+    )
+
+
+def test_zt12_clean_full_commit_chain(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import os
+
+        def _fsync_dir(d):
+            fd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+        def commit(path, blob):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            _fsync_dir(".")
+        """,
+        name="zipkin_tpu/tpu/snapshot.py",
+    )
+    assert rules(result) == []
+
+
+def test_zt12_caller_fsync_split_is_clean(tmp_path):
+    # the Wal._file_for/append split: the opener never fsyncs, but
+    # every in-graph caller does — the graph accepts the split
+    result = lint(
+        tmp_path,
+        """
+        import os
+
+        def _file_for(path):
+            return open(path, "ab")
+
+        def append(path, data):
+            fh = _file_for(path)
+            fh.write(data)
+            os.fsync(fh.fileno())
+        """,
+        name="zipkin_tpu/tpu/wal.py",
+    )
+    assert rules(result) == []
+
+
+def test_zt12_flags_open_when_a_caller_skips_fsync(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        import os
+
+        def _file_for(path):
+            return open(path, "ab")
+
+        def append(path, data):
+            _file_for(path).write(data)
+        """,
+        name="zipkin_tpu/tpu/wal.py",
+    )
+    assert rules(result) == ["ZT12"]
+
+
+def test_zt12_scoped_to_durability_modules(tmp_path):
+    # the same bare rename outside wal/snapshot/timetier/archive is
+    # not a restore-readable file — other modules stay out of scope
+    assert rules(
+        lint(tmp_path, ZT12_BARE_RENAME, name="zipkin_tpu/server/app.py")
+    ) == []
+
+
+# -- ZT13: reader isolation at full cross-module depth -------------------
+
+
+ZT13_TWO_DEEP = {
+    "app/serve.py": """
+        from app import mid
+
+        def snapshot():  # zt-mirror-served: epoch-pinned read surface
+            return mid.resolve()
+    """,
+    "app/mid.py": """
+        def resolve():
+            return _read()
+
+        def _read():
+            with AGG.lock:
+                return 1
+    """,
+}
+
+
+def test_zt13_flags_cross_module_acquire_two_calls_deep(tmp_path):
+    # the injected shape: reader entrypoint → helper module → second
+    # helper that takes the aggregator lock — exactly ZT13's finding
+    result = lint_tree(tmp_path, ZT13_TWO_DEEP)
+    assert rules(result) == ["ZT13"]
+    assert "snapshot" in result.findings[0].message
+    assert "via" in result.findings[0].message
+    clean = lint_tree(tmp_path, ZT13_TWO_DEEP, ignore={"ZT13"})
+    assert rules(clean) == []
+
+
+def test_zt13_same_module_sink_is_zt10s_jurisdiction(tmp_path):
+    # one bug, one rule: a lock acquire in the ROOT's own module is
+    # ZT10's finding and ZT13 stays silent
+    result = lint_tree(
+        tmp_path,
+        {
+            "app/serve.py": """
+                def snapshot():  # zt-mirror-served: epoch-pinned read
+                    return _read()
+
+                def _read():
+                    with AGG.lock:
+                        return 1
+            """,
+        },
+    )
+    assert rules(result) == ["ZT10"]
+
+
+def test_zt13_reader_process_marker_roots_the_walk(tmp_path):
+    files = dict(ZT13_TWO_DEEP)
+    files["app/serve.py"] = """
+        from app import mid
+
+        def reader_main():  # zt-reader-process: mmap-only query worker (ROADMAP item 3)
+            return mid.resolve()
+    """
+    result = lint_tree(tmp_path, files)
+    assert rules(result) == ["ZT13"]
+    assert "reader_main" in result.findings[0].message
+
+
+def test_zt13_reader_marker_without_reason_is_flagged(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "app/serve.py": """
+                def reader_main():  # zt-reader-process
+                    return 1
+            """,
+        },
+    )
+    assert rules(result) == ["ZT13"]
+    assert "reason" in result.findings[0].message
+
+
+def test_zt13_flags_renamed_instrumented_rlock_attr(tmp_path):
+    # renaming the aggregator lock does not launder the acquire: any
+    # attr assigned from InstrumentedRLock anywhere in the program is
+    # a ZT13 sink
+    result = lint_tree(
+        tmp_path,
+        {
+            "app/agg.py": """
+                from zipkin_tpu.obs import querytrace
+
+                class Agg:
+                    def __init__(self):
+                        self._mu = querytrace.InstrumentedRLock(name="agg")
+            """,
+            "app/serve.py": """
+                from app import mid
+
+                def snapshot():  # zt-mirror-served: epoch-pinned read
+                    return mid.resolve()
+            """,
+            "app/mid.py": """
+                def resolve():
+                    AGG._mu.acquire()
+                    return 1
+            """,
+        },
+    )
+    assert rules(result) == ["ZT13"]
+
+
+def test_zt13_clean_lock_free_serve_chain(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "app/serve.py": """
+                from app import mid
+
+                def snapshot():  # zt-mirror-served: epoch-pinned read
+                    return mid.resolve()
+            """,
+            "app/mid.py": """
+                def resolve():
+                    return dict(EPOCH.view)
+            """,
+        },
+    )
+    assert rules(result) == []
+
+
+# -- the PR 15 collision class stays dead (graph-backed resolution) ------
+
+
+def test_same_named_nested_locals_do_not_collide(tmp_path):
+    # the exact PR 15 shape: _disk_query's nested `fetch` vs another
+    # function's nested `fetch` that takes the lock — the name-keyed
+    # walk conflated them (forcing a rename); lexical resolution keeps
+    # each scope's `fetch` its own
+    result = lint(
+        tmp_path,
+        """
+        def serve():  # zt-mirror-served: epoch-pinned read
+            def fetch(k):
+                return k
+            return fetch(1)
+
+        def other():
+            def fetch(k):
+                with AGG.lock:
+                    return k
+            return fetch(1)
+        """,
+    )
+    assert rules(result) == []
+
+
+def test_same_named_methods_on_different_classes_do_not_collide(tmp_path):
+    result = lint(
+        tmp_path,
+        """
+        class Mirror:
+            def serve(self):  # zt-mirror-served: epoch-pinned read
+                return self.fetch(1)
+
+            def fetch(self, k):
+                return k
+
+        class Agg:
+            def fetch(self, k):
+                with self.lock:
+                    return k
+        """,
+    )
+    assert rules(result) == []

@@ -3,13 +3,17 @@
 Successor to tests/test_read_path_lint.py — where that file pinned one
 module's read surface, ZT-lint walks every module for every TPU
 invariant (one-transfer chokepoint, recompile hazards, lock discipline,
-donation misuse, blocking syncs), so a new entrypoint added anywhere is
-checked without registering it in a test. Runs the linter IN-PROCESS
-(same code path as ``python -m zipkin_tpu.lint zipkin_tpu/``).
+donation misuse, blocking syncs, seqlock/durability/reader-isolation
+protocols), so a new entrypoint added anywhere is checked without
+registering it in a test. Runs the linter IN-PROCESS (same code path as
+``python -m zipkin_tpu.lint zipkin_tpu/``). Also pins the engine's
+runtime contract: one shared call graph per run, mtime-cached module
+parses, and a hard wall-clock budget for the whole-tree walk.
 """
 
 from __future__ import annotations
 
+import json
 import pathlib
 
 from zipkin_tpu.lint import all_checkers, run_paths
@@ -48,8 +52,76 @@ def test_lint_package_lints_itself_clean():
 def test_full_rule_catalog_registered():
     assert sorted(all_checkers()) == [
         "ZT00", "ZT01", "ZT02", "ZT03", "ZT04", "ZT05", "ZT06", "ZT07",
-        "ZT08", "ZT09", "ZT10",
+        "ZT08", "ZT09", "ZT10", "ZT11", "ZT12", "ZT13",
     ]
+
+
+def test_runtime_budget_and_one_shared_graph(monkeypatch):
+    """The engine's cost contract: the whole-tree walk builds the
+    interprocedural call graph exactly ONCE (every rule shares it — a
+    per-rule rebuild would be O(rules × tree)) and the full run fits a
+    60 s budget (~20× headroom over the measured ~3 s on the CI class
+    of machine; a superlinear regression in resolution or reachability
+    blows through 20× long before it merges)."""
+    from zipkin_tpu.lint import callgraph
+
+    builds = []
+    orig_init = callgraph.CallGraph.__init__
+
+    def counting_init(self, modules):
+        builds.append(True)
+        orig_init(self, modules)
+
+    monkeypatch.setattr(callgraph.CallGraph, "__init__", counting_init)
+    result = run_paths([str(ROOT / "zipkin_tpu")], root=ROOT)
+    assert builds.count(True) == 1
+    assert result.stats["functions"] > 500, result.stats
+    assert result.stats["edges"] > 1000, result.stats
+    assert result.stats["elapsed_ms"] < 60_000, result.stats
+
+
+def test_module_cache_reuses_parses_across_runs():
+    """Unchanged files are NOT reparsed on the next run: the mtime+size
+    keyed cache hands back the same Module objects, so editor/watch
+    loops pay only for what they touched."""
+    from zipkin_tpu.lint import core
+
+    target = [str(ROOT / "zipkin_tpu" / "lint")]
+    run_paths(target, root=ROOT)
+    before = {k: id(v[2]) for k, v in core._MODULE_CACHE.items()}
+    run_paths(target, root=ROOT)
+    after = {k: id(v[2]) for k, v in core._MODULE_CACHE.items()}
+    shared = set(before) & set(after)
+    assert shared, "cache empty after a run"
+    assert all(before[k] == after[k] for k in shared)
+
+
+def test_cli_json_format(capsys):
+    """--format json: ONE machine-readable document on stdout carrying
+    findings, suppressions, run stats, and the exit code."""
+    from zipkin_tpu.lint.cli import main
+
+    rc = main([str(ROOT / "zipkin_tpu" / "lint"), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["exit_code"] == 0
+    assert doc["findings"] == []
+    assert doc["stats"]["files"] > 0
+    assert doc["stats"]["functions"] > 0
+    assert set(doc) == {
+        "findings", "suppressed", "baselined", "errors", "stats",
+        "exit_code",
+    }
+
+
+def test_cli_stats_line(capsys):
+    from zipkin_tpu.lint.cli import main
+
+    rc = main([str(ROOT / "zipkin_tpu" / "lint"), "--stats"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "zt-lint stats:" in err
+    assert "call edge(s)" in err
 
 
 def test_every_shipped_suppression_carries_a_reason():

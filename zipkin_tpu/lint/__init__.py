@@ -5,11 +5,14 @@ transfer per query, no serving-time recompiles, lock-coherent shared
 state, donation discipline, no stray device syncs — are exactly the
 ones a reviewer cannot reliably re-check by hand every round. This
 package makes them mechanical: an AST checker framework (core.py), a
-function-local device-taint analysis (taint.py), six rules grounded in
-real past regressions (checkers/), inline suppression pragmas with
-mandatory justifications, baselines, and a CLI
-(``python -m zipkin_tpu.lint``). tests/test_lint_clean.py runs the full
-tree through it in tier-1, so every future PR is gated.
+whole-program call-graph engine (callgraph.py — qualified-name
+resolution, bounded-depth reachability, cross-module taint summaries),
+a device-taint analysis layered on it (taint.py), fourteen rules
+grounded in real past regressions (checkers/), inline suppression
+pragmas with mandatory justifications, baselines, and a CLI
+(``python -m zipkin_tpu.lint``, ``--format json``/``--stats``).
+tests/test_lint_clean.py runs the full tree through it in tier-1, so
+every future PR is gated.
 
 Public API: :func:`zipkin_tpu.lint.core.run_paths` and the
 :class:`~zipkin_tpu.lint.core.Finding` dataclass; see ARCHITECTURE.md

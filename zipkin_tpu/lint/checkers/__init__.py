@@ -29,19 +29,42 @@ Rule catalog (grounded in real past regressions — see ARCHITECTURE.md
   never O(spans); justified non-per-span loops carry ZT09 pragmas.
 - ZT10 mirror-served lock acquires: aggregator-lock acquisition (bare
   ``.lock`` holds, or calls into known lock-taking helpers) reachable
-  from functions marked ``# zt-mirror-served`` — the epoch-published
-  read mirror's serve path must never re-queue readers on the lock.
+  from functions marked ``# zt-mirror-served`` within the module — the
+  epoch-published read mirror's serve path must never re-queue readers
+  on the lock (cross-module chains are ZT13's).
+- ZT11 seqlock discipline: writes to registered shm seqlock regions
+  (ring slot headers, mirror epoch, critpath ledger slots, recorder
+  histograms) must sit inside an odd/even generation-stamp bracket on
+  the SAME generation word; gen-aware readers must re-read the
+  generation after copying.
+- ZT12 durability commit: in ``wal``/``snapshot``/``timetier``/
+  ``archive``, restore-readable files flow through the
+  tmp+fsync+rename+dir-fsync chokepoints — a bare write-mode ``open``
+  or an ``os.replace`` without fsync on its path is a finding.
+- ZT13 reader isolation: aggregator-lock / ``InstrumentedRLock``
+  acquires statically unreachable — at full interprocedural, cross-
+  module depth over the whole-program call graph — from
+  ``# zt-mirror-served`` and ``# zt-reader-process`` entrypoints (the
+  static gate for the ROADMAP's multi-process read front end).
+
+ZT07/ZT08/ZT13 walk the shared whole-program call graph built once per
+run (``lint/callgraph.py``: qualified-name resolution, bounded-depth
+reachability, cross-module taint summaries); ZT01/ZT02/ZT04/ZT09/ZT10
+consult it per module for summaries, caller proofs, and callee hops.
 """
 
 from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
     blocking,
     dispatchloop,
     donation,
+    durability,
     freshread,
     locks,
     mirrorread,
     obsstage,
     pragmas,
+    readeriso,
     recompile,
+    seqlock,
     transfers,
 )

@@ -18,6 +18,15 @@ provably NOT per-span carry a standard ``zt-lint: disable=ZT09`` pragma
 whose justification says what the trip count actually is (per new
 string, per chunk, ...) — the pragma audit IS the documentation that
 the critical section stayed vectorized.
+
+The marker's audit used to stop at the function boundary: hide the
+per-span loop in a helper and call the helper, and ZT09 was blind.
+With the call graph the rule is compositional one hop out, cross-
+module: a call from a marked function that RESOLVES to an unmarked,
+loop-bearing callee is flagged at the CALL SITE. The fix is to mark
+the callee (putting its loops under this same audit) or to pragma the
+call with the trip-count justification. Hops beyond the first are
+covered inductively — marking the callee makes ITS calls audited.
 """
 
 from __future__ import annotations
@@ -32,6 +41,19 @@ _LOOP_KINDS = (ast.For, ast.AsyncFor, ast.While)
 _COMP_KINDS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
 
 MARKER_RE = re.compile(r"#\s*zt-dispatch-critical\b(?P<rest>.*)$")
+
+
+def _has_own_loop(fn: ast.AST) -> bool:
+    """A loop/comprehension in fn's own body (nested defs excluded —
+    they are separate functions with their own edges)."""
+    nested = set()
+    for n in ast.walk(fn):
+        if isinstance(n, _FUNC_KINDS) and n is not fn:
+            nested.update(id(x) for x in ast.walk(n))
+    return any(
+        isinstance(n, _LOOP_KINDS + _COMP_KINDS) and id(n) not in nested
+        for n in ast.walk(fn)
+    )
 
 
 def _marker(module: Module, fn: ast.AST):
@@ -98,3 +120,36 @@ class DispatchCriticalLoops(Checker):
                     f"{fn.name}() — a per-span trip count here caps "
                     "every parse worker at one interpreter's speed",
                 )
+            yield from self._check_callees(module, fn)
+
+    def _check_callees(self, module: Module, fn: ast.AST):
+        """Compositional hop: calls resolving to unmarked loop-bearing
+        functions — the hidden-helper-loop shape."""
+        graph = self.graph(module)
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            for qual, _resolved in graph.callees_of_call(call):
+                info = graph.functions.get(qual)
+                if info is None or "<locals>" in qual:
+                    continue  # nested defs are inside the marked body
+                callee_mod = graph.module_for(info.module_rel) or module
+                if _marker(callee_mod, info.node) is not None:
+                    continue  # marked callee: its loops carry the audit
+                if not _has_own_loop(info.node):
+                    continue
+                # one finding per call site even when the conservative
+                # fallback offers several loop-bearing candidates
+                yield self.found(
+                    module, call,
+                    f"dispatch-critical {fn.name}() calls "
+                    f"{info.name}() [{info.module_rel}], which contains "
+                    "a Python loop but is not marked zt-dispatch-"
+                    "critical — the helper's trip count is unaudited",
+                    hint=(
+                        "mark the callee zt-dispatch-critical (its "
+                        "loops then need per-trip-count justification) "
+                        "or pragma this call with the bound"
+                    ),
+                )
+                break

@@ -10,11 +10,13 @@ from-scratch rebuilders — reachable from a fresh-read entrypoint is a
 reintroduction of the full-ring cost and fails tier-1
 (tests/test_lint_clean.py).
 
-Mechanics: per module, functions named in ``FRESH_READ_ENTRYPOINTS``
-seed a call-graph walk over locally-defined functions (bare-name and
-attribute calls both descend when a local def matches — conservative:
-cross-module edges can't be followed, so each module on the path names
-its own entrypoint). Inside reachable functions two shapes are flagged:
+Mechanics: functions named in ``FRESH_READ_ENTRYPOINTS`` — wherever
+they live — seed a walk over the WHOLE-PROGRAM call graph (qualified-
+name resolution; conservative fallback edges descend into same-module
+defs and uniquely-named imported methods, over-approximating rather
+than missing a helper), so a sort can no longer hide one import away.
+Inside reachable functions, in whatever module the walk lands, two
+shapes are flagged:
 
 1. sort/scan-family calls: ``lax.sort``, ``jnp.sort``, ``jnp.argsort``,
    ``jnp.lexsort``, ``lax.associative_scan``, ``lax.scan``.
@@ -42,7 +44,9 @@ that answers an uncovered window by rescanning the span archive
 query, exactly the cost the tier exists to avoid; uncovered epochs are
 reported as coverage gaps instead). That walk is UNGATED on jax
 imports: the windowed routing layer is pure host code and must stay
-fenced even if it moves out of a jax-importing module.
+fenced even if it moves out of a jax-importing module. (The sort fence
+gates on the ROOT's module importing jax — the hazard is a device
+sort/scan, which a jax-free entrypoint module cannot seed.)
 """
 
 from __future__ import annotations
@@ -55,8 +59,9 @@ from zipkin_tpu.lint.taint import _root_name
 _FUNC_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 # the query-path surface: functions that run (or build the program for)
-# a FRESH read — every module on the fresh path names its own entrypoint
-# because the walk cannot follow imports
+# a FRESH read. The graph follows imports now, so seeding each module's
+# own entrypoint is belt-and-braces rather than a necessity; the names
+# stay because each IS an entrypoint of its tier.
 FRESH_READ_ENTRYPOINTS = {
     "spmd_link_ctx",        # parallel/sharded.py: ctx-only program
     "spmd_edges_fresh",     # parallel/sharded.py: fused ctx+edges program
@@ -75,7 +80,7 @@ FULL_REBUILDERS = {"link_context", "resolve_parents"}
 
 # windowed sketch-tier entrypoints (tpu/store.py, ISSUE 15): queries
 # carrying a [lookback, endTs] range answer from merged time-bucket
-# segments — same per-module seeding rule as the fresh-read set
+# segments
 WINDOWED_ENTRYPOINTS = {
     "latency_quantiles",
     "trace_cardinalities",
@@ -97,29 +102,6 @@ def _callee_name(func: ast.AST):
     return None
 
 
-def _reach(defs, roots):
-    """Conservative local reachability: def node -> (node, seed name).
-
-    Bare-name and attribute calls both descend when a local def
-    matches — over-approximate rather than miss a helper; cross-module
-    edges can't be followed, so each module on a fenced path names its
-    own entrypoints.
-    """
-    reached = {}
-    stack = [(d, d.name) for d in roots]
-    while stack:
-        fn, root = stack.pop()
-        if fn.name in reached:
-            continue
-        reached[fn.name] = (fn, root)
-        for call in ast.walk(fn):
-            if isinstance(call, ast.Call):
-                tgt = defs.get(_callee_name(call.func))
-                if tgt is not None and tgt.name not in reached:
-                    stack.append((tgt, root))
-    return reached
-
-
 @register
 class FreshReadRingSort(Checker):
     rule = "ZT07"
@@ -134,25 +116,39 @@ class FreshReadRingSort(Checker):
         "(ops/delta_linker.py); move full-ring work to rollup cadence, "
         "or suppress with a reason stating the delta-size bound"
     )
+    whole_program = True
 
-    def check(self, module: Module):
-        defs = {}
-        for node in ast.walk(module.tree):
-            if isinstance(node, _FUNC_KINDS):
-                defs.setdefault(node.name, node)
-        # walk 1 — fresh-read sort fence, gated on jax imports (the
-        # hazard is a device sort/scan; a jax-free module can't emit one)
-        if module.imported_roots & {"jax", "jnp"}:
-            roots = [
-                d for n, d in defs.items() if n in FRESH_READ_ENTRYPOINTS
-            ]
-            for fn, root in _reach(defs, roots).values():
-                yield from self._scan_function(module, fn, root)
-        # walk 2 — windowed archive-scan fence, UNGATED: the windowed
-        # routing layer is pure host code (see module docstring)
-        w_roots = [d for n, d in defs.items() if n in WINDOWED_ENTRYPOINTS]
-        for fn, root in _reach(defs, w_roots).values():
-            yield from self._scan_windowed(module, fn, root)
+    def check_program(self, program):
+        fresh_roots, windowed_roots = [], []
+        for module in program.modules:
+            jax_gated = bool(module.imported_roots & {"jax", "jnp"})
+            for fn in ast.walk(module.tree):
+                if not isinstance(fn, _FUNC_KINDS):
+                    continue
+                qual = program.qual_of(fn)
+                if qual is None:
+                    continue
+                # sort fence gates on the ROOT's module importing jax:
+                # the hazard is a device sort/scan, which a jax-free
+                # entrypoint module cannot seed
+                if fn.name in FRESH_READ_ENTRYPOINTS and jax_gated:
+                    fresh_roots.append(qual)
+                if fn.name in WINDOWED_ENTRYPOINTS:
+                    windowed_roots.append(qual)
+        for qual, (root, _d, _p) in program.reach(fresh_roots).items():
+            info = program.functions[qual]
+            module = program.module_for(info.module_rel)
+            if module is not None:
+                yield from self._scan_function(
+                    module, info.node, program.functions[root].name
+                )
+        for qual, (root, _d, _p) in program.reach(windowed_roots).items():
+            info = program.functions[qual]
+            module = program.module_for(info.module_rel)
+            if module is not None:
+                yield from self._scan_windowed(
+                    module, info.node, program.functions[root].name
+                )
 
     def _scan_function(self, module: Module, fn: ast.AST, root: str):
         for node in ast.walk(fn):

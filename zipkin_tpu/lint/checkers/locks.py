@@ -19,8 +19,13 @@ write) outside any with-lock block — in any method except ``__init__``
 (construction precedes concurrency) — is a finding.
 
 "Callers hold the lock" helper methods are real and common (the
-aggregator's ``_flush_now``); they are exactly what the scoped pragma on
-the ``def`` line is for, with the justification naming the lock.
+aggregator's ``_flush_now``). When the call graph can PROVE the claim —
+every in-graph call site of the method is a same-class call lexically
+inside ``with self.<lock>:`` — the write is accepted without ceremony;
+the scoped pragma on the ``def`` line (justification naming the lock)
+remains for the cases the graph can't see (callbacks, cross-class
+protocols, calls from outside the linted tree). One unguarded caller
+kills the proof: that caller IS the race.
 """
 
 from __future__ import annotations
@@ -121,9 +126,11 @@ class LockDiscipline(Checker):
             return
         # every write site: (attr, node, method, guarded?)
         writes: List[Tuple[str, ast.AST, str, bool]] = []
+        methods: Dict[str, ast.AST] = {}
         for method in cls.body:
             if not isinstance(method, _FUNC_KINDS):
                 continue
+            methods[method.name] = method
             for node in ast.walk(method):
                 targets = []
                 if isinstance(node, ast.Assign):
@@ -146,9 +153,16 @@ class LockDiscipline(Checker):
         for attr, _, meth, guarded in writes:
             if guarded:
                 guarded_attrs.setdefault(attr, set()).add(meth)
+        callers_hold: Dict[str, bool] = {}
         for attr, node, meth, guarded in writes:
             if guarded or attr not in guarded_attrs or meth == "__init__":
                 continue
+            if meth not in callers_hold:
+                callers_hold[meth] = self._callers_hold_lock(
+                    module, methods[meth], lock_attrs
+                )
+            if callers_hold[meth]:
+                continue  # the graph proves every call site holds it
             lockers = ", ".join(sorted(guarded_attrs[attr]))
             yield self.found(
                 module,
@@ -156,3 +170,27 @@ class LockDiscipline(Checker):
                 f"{cls.name}.{attr} written lock-free in {meth}() but "
                 f"under a lock in {lockers}() — the r5 sidecar-race shape",
             )
+
+    def _callers_hold_lock(
+        self, module: Module, method: ast.AST, lock_attrs: Set[str]
+    ) -> bool:
+        """The interprocedural caller-holds-the-lock proof: every
+        in-graph call site is a same-class call made inside ``with
+        self.<lock>:``. No callers ⇒ no proof (an entrypoint nobody
+        calls locked is exactly the bug)."""
+        graph = self.graph(module)
+        qual = graph.qual_of(method)
+        if qual is None:
+            return False
+        owner = qual.rsplit(".", 1)[0]  # module.Class prefix
+        sites = graph.call_sites_of(qual)
+        if not sites:
+            return False
+        for caller_qual, call in sites:
+            if caller_qual.rsplit(".", 1)[0] != owner:
+                return False  # cross-class call: same-named lock ≠ same lock
+            caller_info = graph.functions[caller_qual]
+            caller_mod = graph.module_for(caller_info.module_rel) or module
+            if not _with_locks(caller_mod, call, lock_attrs):
+                return False
+        return True

@@ -13,9 +13,11 @@ SLO gone, and no unit test notices.
 Functions opt in with a ``# zt-mirror-served: <reason>`` marker on the
 ``def`` header (multi-line signatures work, same mechanics as ZT09's
 dispatch-critical marker). From each marked function the rule walks the
-local call graph (ZT07's conservative reachability: bare-name and
-attribute calls both descend into same-module defs) and flags, anywhere
-reachable:
+whole-program call graph restricted to the module (qualified-name
+resolution: bare names bind lexically, ``self.m()`` binds to the
+enclosing class, unknown attribute receivers fall back conservatively
+to same-module defs — over-approximate rather than miss a helper) and
+flags, anywhere reachable:
 
 1. taking the aggregator lock itself — ``with X.lock:`` or
    ``X.lock.acquire(...)`` where the attribute is spelled exactly
@@ -31,6 +33,10 @@ reachable:
 
 A marker without a reason is itself a finding (the ZT00 bar: opt-in
 claims are reviewable statements, not magic words).
+
+This rule stays same-module on purpose: chains that LEAVE the module
+are ZT13's jurisdiction (reader isolation at full interprocedural
+depth), so one bug yields one rule's finding.
 """
 
 from __future__ import annotations
@@ -110,12 +116,10 @@ class MirrorServedLockAcquire(Checker):
     )
 
     def check(self, module: Module):
-        defs = {}
-        for node in ast.walk(module.tree):
-            if isinstance(node, _FUNC_KINDS):
-                defs.setdefault(node.name, node)
         roots = []
-        for fn in defs.values():
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, _FUNC_KINDS):
+                continue
             marked = _marker(module, fn)
             if marked is None:
                 continue
@@ -130,22 +134,21 @@ class MirrorServedLockAcquire(Checker):
             roots.append(fn)
         if not roots:
             return
-        # reachability over local defs (ZT07's walk: attribute calls
-        # descend too — over-approximate rather than miss a helper)
-        reached = {}
-        stack = [(d, d.name) for d in roots]
-        while stack:
-            fn, root = stack.pop()
-            if fn.name in reached:
+        # qualified-name reachability within the module (cross-module
+        # chains are ZT13's); conservative fallback edges included —
+        # over-approximate rather than miss a helper
+        graph = self.graph(module)
+        root_quals = [q for q in map(graph.qual_of, roots) if q]
+        reached = graph.reach(root_quals, same_module=True)
+        seen = set()  # one scan per function even when several roots reach it
+        for qual, (root, _depth, _pred) in reached.items():
+            info = graph.functions[qual]
+            if info.module_rel != module.rel or id(info.node) in seen:
                 continue
-            reached[fn.name] = (fn, root)
-            for call in ast.walk(fn):
-                if isinstance(call, ast.Call):
-                    tgt = defs.get(_callee_name(call.func))
-                    if tgt is not None and tgt.name not in reached:
-                        stack.append((tgt, root))
-        for fn, root in reached.values():
-            yield from self._scan_function(module, fn, root)
+            seen.add(id(info.node))
+            yield from self._scan_function(
+                module, info.node, graph.functions[root].name
+            )
 
     def _scan_function(self, module: Module, fn: ast.AST, root: str):
         via = "" if fn.name == root else f" (via {fn.name}())"

@@ -12,9 +12,12 @@ Two shapes are flagged:
    — recording a single bogus near-zero sample, then silently never
    again — or fail outright under tracing. Traced defs are those
    decorated with (or passed to) ``jax.jit``/``shard_map``, plus
-   everything they reach through locally-defined helpers (the ZT07
-   reachability shape: attribute calls descend too, over-approximating
-   rather than missing a helper).
+   everything they reach through the whole-program call graph's
+   RESOLVED edges (lexical/self/import resolution) at cross-module
+   depth. Fallback name-keyed edges are deliberately excluded from this
+   walk: traced code calling ``x.m()`` on an unknown receiver must not
+   smear "traced" onto every same-named host method — precision rules
+   ride resolved edges, fence rules keep the over-approximation.
 2. A ``record()`` stage argument that is not a string literal from the
    taxonomy. Literal-only keeps every stage name greppable and lets
    this rule verify membership statically; a dynamic stage would also
@@ -106,14 +109,6 @@ def _is_trace_call(node: ast.AST) -> bool:
     return False
 
 
-def _callee_name(func: ast.AST):
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    return None
-
-
 @register
 class ObsStageDiscipline(Checker):
     rule = "ZT08"
@@ -128,23 +123,44 @@ class ObsStageDiscipline(Checker):
         "obs.stages.STAGES; to add a stage extend obs/stages.py"
     )
 
-    def check(self, module: Module):
-        if "zipkin_tpu" not in module.imported_roots:
+    whole_program = True
+
+    def check_program(self, program):
+        aliases = {}  # module rel -> (record aliases, hook aliases)
+        traced_roots = []
+        for module in program.modules:
+            if "zipkin_tpu" not in module.imported_roots:
+                continue
+            bare, bare_hooks = self._bare_aliases(module)
+            aliases[module.rel] = (bare, bare_hooks)
+            records = [
+                node
+                for node in ast.walk(module.tree)
+                if self._is_record_call(node, bare)
+            ]
+            yield from self._check_stage_args(module, records)
+            if module.imported_roots & {"jax", "jnp"}:
+                traced_roots.extend(
+                    q for q in map(
+                        program.qual_of, self._traced_defs(module)
+                    ) if q
+                )
+        if not traced_roots:
             return
-        bare, bare_hooks = self._bare_aliases(module)
-        records = [
-            node
-            for node in ast.walk(module.tree)
-            if self._is_record_call(node, bare)
-        ]
-        hooks = any(
-            self._is_hook_call(node, bare_hooks)
-            for node in ast.walk(module.tree)
-        )
-        if not records and not hooks:
-            return
-        yield from self._check_stage_args(module, records)
-        yield from self._check_traced_reach(module, bare, bare_hooks)
+        # traced-reach rides RESOLVED edges only (module docstring)
+        reached = program.reach(traced_roots, resolved_only=True)
+        for qual, (root, _d, _p) in reached.items():
+            info = program.functions[qual]
+            module = program.module_for(info.module_rel)
+            if module is None:
+                continue
+            if module.rel not in aliases:
+                aliases[module.rel] = self._bare_aliases(module)
+            bare, bare_hooks = aliases[module.rel]
+            yield from self._scan_traced(
+                module, info.node, program.functions[root].name,
+                bare, bare_hooks,
+            )
 
     # -- record/hook call recognition --------------------------------------
 
@@ -211,9 +227,8 @@ class ObsStageDiscipline(Checker):
 
     # -- shape 1: no recording inside device-traced code -------------------
 
-    def _check_traced_reach(self, module: Module, bare: set, bare_hooks: set):
-        if not module.imported_roots & {"jax", "jnp"}:
-            return
+    def _traced_defs(self, module: Module):
+        """Defs decorated with (or passed by name to) jit/shard_map."""
         defs = {}
         for node in ast.walk(module.tree):
             if isinstance(node, _FUNC_KINDS):
@@ -228,41 +243,29 @@ class ObsStageDiscipline(Checker):
                     tgt = defs.get(arg.id) if isinstance(arg, ast.Name) else None
                     if tgt is not None:
                         traced.append(tgt)
-        if not traced:
-            return
-        reached = {}  # name -> (def node, traced root name)
-        stack = [(d, d.name) for d in traced]
-        while stack:
-            fn, root = stack.pop()
-            if fn.name in reached:
-                continue
-            reached[fn.name] = (fn, root)
-            for call in ast.walk(fn):
-                if isinstance(call, ast.Call):
-                    tgt = defs.get(_callee_name(call.func))
-                    if tgt is not None and tgt.name not in reached:
-                        stack.append((tgt, root))
-        for fn, root in reached.values():
-            for node in ast.walk(fn):
-                if self._is_record_call(node, bare):
-                    where = "" if fn.name == root else f" (via {fn.name}())"
-                    yield self.found(
-                        module,
-                        node,
-                        f"obs.record inside device-traced {root}(){where} "
-                        "— host-side instrumentation runs once at trace "
-                        "time, then never again",
-                    )
-                elif self._is_hook_call(node, bare_hooks):
-                    where = "" if fn.name == root else f" (via {fn.name}())"
-                    yield self.found(
-                        module,
-                        node,
-                        f"obs windows/device hook inside device-traced "
-                        f"{root}(){where} — ring/registry mutation is host "
-                        "code; under tracing it burns in a trace-time "
-                        "constant",
-                    )
+        return traced
+
+    def _scan_traced(self, module, fn, root, bare, bare_hooks):
+        for node in ast.walk(fn):
+            if self._is_record_call(node, bare):
+                where = "" if fn.name == root else f" (via {fn.name}())"
+                yield self.found(
+                    module,
+                    node,
+                    f"obs.record inside device-traced {root}(){where} "
+                    "— host-side instrumentation runs once at trace "
+                    "time, then never again",
+                )
+            elif self._is_hook_call(node, bare_hooks):
+                where = "" if fn.name == root else f" (via {fn.name}())"
+                yield self.found(
+                    module,
+                    node,
+                    f"obs windows/device hook inside device-traced "
+                    f"{root}(){where} — ring/registry mutation is host "
+                    "code; under tracing it burns in a trace-time "
+                    "constant",
+                )
 
 
 def _trace_target(dec: ast.AST) -> bool:

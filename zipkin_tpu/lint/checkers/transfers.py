@@ -13,7 +13,10 @@ checkers apply the same invariant to the whole tree.
   ``.item()``/``.tolist()``, or any ``jax.device_get`` call, outside the
   sanctioned chokepoint module (``zipkin_tpu/readpack.py``). Route pulls
   through ``readpack.pull``/``readpack.device_get`` so ``hostTransfers``
-  counts them.
+  counts them. Taint is per-function dataflow PLUS whole-program return
+  summaries over resolved call-graph edges: ``np.asarray(helper(x))``
+  is a transfer when ``helper`` — in this module or another — returns a
+  device value.
 - **ZT02**: the multi-pull *shape* — ≥2 host pulls in a single function
   (each pays the transport round trip; pack on device and pull once), or
   a ``return np.asarray(a), np.asarray(b), ...`` tuple anywhere (a
@@ -69,6 +72,22 @@ def _iter_functions(module: Module):
     for node in ast.walk(module.tree):
         if isinstance(node, _FUNC_KINDS):
             yield node
+
+
+def _taint_for(checker: Checker, fn: ast.AST) -> FunctionTaint:
+    """Per-function taint wired to the run's cross-module return
+    summaries (resolved edges only) when the graph is available."""
+    graph = checker.program
+    if graph is None:
+        return FunctionTaint(fn)
+
+    def resolver(call: ast.Call) -> bool:
+        return any(
+            resolved and graph.returns_tainted(qual)
+            for qual, resolved in graph.callees_of_call(call)
+        )
+
+    return FunctionTaint(fn, call_resolver=resolver)
 
 
 def _host_pulls(module: Module, fn: ast.AST, taint: FunctionTaint):
@@ -139,7 +158,7 @@ class HostTransferChokepoint(Checker):
             # np.asarray there is host-only input coercion
             return
         for fn in _iter_functions(module):
-            taint = FunctionTaint(fn)
+            taint = _taint_for(self, fn)
             for node, kind in _host_pulls(module, fn, taint):
                 if kind == "chokepoint pull":
                     continue  # sanctioned (counted) — ZT02 counts them
@@ -168,7 +187,7 @@ class MultiPullShapes(Checker):
         has_jax = bool(module.imported_roots & {"jax", "jnp"})
         for fn in _iter_functions(module):
             if has_jax:
-                taint = FunctionTaint(fn)
+                taint = _taint_for(self, fn)
                 pulls = list(_host_pulls(module, fn, taint))
                 if len(pulls) >= 2:
                     kinds = ", ".join(k for _, k in pulls)

@@ -8,6 +8,7 @@ tier-1 (tests/test_lint_clean.py runs the same entry in-process).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -72,6 +73,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "-q", "--quiet", action="store_true", help="findings only, no summary"
     )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format: human-readable text (default) or one JSON "
+        "document (findings + suppressions + run stats) on stdout",
+    )
+    p.add_argument(
+        "--stats",
+        action="store_true",
+        help="print run statistics (files, call-graph size, wall time) "
+        "after the summary",
+    )
     return p
 
 
@@ -89,6 +103,11 @@ def main(argv=None) -> int:
         baseline=baseline,
         root=Path.cwd(),
     )
+    if args.format == "json" and not args.write_baseline:
+        # one machine-readable document on stdout, nothing else — the
+        # CI consumer parses stdout and keys off the exit code
+        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+        return result.exit_code
     for err in result.errors:
         print(f"ERROR {err}", file=sys.stderr)
     if args.write_baseline:
@@ -115,6 +134,16 @@ def main(argv=None) -> int:
             f"zt-lint: {len(result.findings)} finding(s) in {n_files} "
             f"file(s); {len(result.suppressed)} suppressed by pragma, "
             f"{len(result.baselined)} baselined",
+            file=sys.stderr,
+        )
+    if args.stats:
+        s = result.stats
+        print(
+            "zt-lint stats: {files} file(s), {functions} function(s), "
+            "{edges} call edge(s), {rules} rule(s), {elapsed_ms:.0f} ms".format(
+                **{k: s.get(k, 0) for k in
+                   ("files", "functions", "edges", "rules", "elapsed_ms")}
+            ),
             file=sys.stderr,
         )
     return result.exit_code

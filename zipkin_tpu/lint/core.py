@@ -26,6 +26,7 @@ import ast
 import io
 import json
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -161,13 +162,25 @@ def _parse_pragmas(source: str) -> Iterator[Pragma]:
 
 class Checker:
     """Base checker. Subclasses set ``rule``/``severity``/``hint`` and
-    implement :meth:`check`, yielding findings (use :meth:`found`)."""
+    implement :meth:`check` (per module) or — with ``whole_program =
+    True`` — :meth:`check_program` (once per run, over the call graph),
+    yielding findings (use :meth:`found`).
+
+    During a run the shared :class:`~zipkin_tpu.lint.callgraph.CallGraph`
+    is bound to ``self.program`` (None when linting without the graph,
+    e.g. a single file fed to :meth:`check` directly in a unit test), so
+    per-module checkers can consult interprocedural facts — resolve a
+    call, walk callers, ask for a cross-module taint summary — without
+    rebuilding anything: the graph is built once and shared by every
+    rule."""
 
     rule: str = "ZT??"
     severity: str = "error"
     name: str = ""
     doc: str = ""
     hint: str = ""
+    whole_program: bool = False
+    program = None  # bound by run_paths for the duration of a run
 
     def found(
         self, module: Module, node: ast.AST, message: str, hint: str = ""
@@ -182,8 +195,21 @@ class Checker:
             hint=hint or self.hint,
         )
 
-    def check(self, module: Module) -> Iterable[Finding]:  # pragma: no cover
-        raise NotImplementedError
+    def check(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def check_program(self, program) -> Iterable[Finding]:
+        return ()
+
+    def graph(self, module: Module):
+        """The run's shared CallGraph, or (when a checker is driven
+        directly against one module, outside run_paths) a fresh
+        single-module graph — resolution semantics are identical."""
+        if self.program is not None:
+            return self.program
+        from zipkin_tpu.lint.callgraph import CallGraph
+
+        return CallGraph([module])
 
 
 _REGISTRY: Dict[str, Checker] = {}
@@ -238,10 +264,45 @@ class RunResult:
     suppressed: List[Finding] = field(default_factory=list)      # pragma'd
     baselined: List[Finding] = field(default_factory=list)       # in baseline
     errors: List[str] = field(default_factory=list)              # parse errors
+    # the pragma that suppressed each entry of ``suppressed``, same order
+    suppressed_pragmas: List[Pragma] = field(default_factory=list)
+    stats: Dict[str, float] = field(default_factory=dict)
 
     @property
     def exit_code(self) -> int:
         return 1 if self.findings or self.errors else 0
+
+    def to_dict(self) -> Dict:
+        """Machine-readable shape for ``--format json``: every finding
+        with rule/path/line plus its pragma status (live findings have
+        ``pragma: null``; suppressed ones carry line + reason)."""
+
+        def one(f: Finding, pragma: Optional[Pragma]) -> Dict:
+            return {
+                "rule": f.rule,
+                "severity": f.severity,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "hint": f.hint,
+                "pragma": None if pragma is None else {
+                    "line": pragma.line,
+                    "reason": pragma.reason,
+                },
+            }
+
+        return {
+            "findings": [one(f, None) for f in self.findings],
+            "suppressed": [
+                one(f, p)
+                for f, p in zip(self.suppressed, self.suppressed_pragmas)
+            ],
+            "baselined": [one(f, None) for f in self.baselined],
+            "errors": list(self.errors),
+            "stats": dict(self.stats),
+            "exit_code": self.exit_code,
+        }
 
 
 def iter_py_files(paths: Sequence, root: Optional[Path] = None) -> Iterator[Path]:
@@ -251,6 +312,24 @@ def iter_py_files(paths: Sequence, root: Optional[Path] = None) -> Iterator[Path
             yield from sorted(p.rglob("*.py"))
         elif p.suffix == ".py":
             yield p
+
+
+# Parse cache: (resolved path, rel) -> (mtime_ns, size, Module). Parsing
+# + parent-map construction dominates lint wall time, so repeat runs in
+# one process (tier-1 runs the linter several times) only re-parse files
+# whose mtime or size changed.
+_MODULE_CACHE: Dict[Tuple[str, str], Tuple[int, int, Module]] = {}
+
+
+def _load_module(path: Path, rel: str) -> Module:
+    st = path.stat()
+    key = (str(path.resolve()), rel)
+    hit = _MODULE_CACHE.get(key)
+    if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+        return hit[2]
+    module = Module(path, rel, path.read_text())
+    _MODULE_CACHE[key] = (st.st_mtime_ns, st.st_size, module)
+    return module
 
 
 def run_paths(
@@ -263,7 +342,15 @@ def run_paths(
     """Lint every .py under ``paths``. ``select``/``ignore`` are rule-id
     sets (select wins first, then ignore removes). ZT00 (suppression
     hygiene) always runs: disabling the meta-rule would let reasonless
-    pragmas rot silently."""
+    pragmas rot silently.
+
+    Two-phase: every file is parsed first (mtime-cached), the whole-
+    program call graph is built ONCE over the parsed set, then each rule
+    runs with the graph bound to ``checker.program`` — per-module rules
+    over each file, ``whole_program`` rules once over the graph."""
+    from zipkin_tpu.lint.callgraph import CallGraph
+
+    t0 = time.monotonic()
     checkers = all_checkers()
     active = {
         rule: c
@@ -273,21 +360,56 @@ def run_paths(
     }
     root = Path(root) if root is not None else Path.cwd()
     result = RunResult()
+    modules: List[Module] = []
+    by_rel: Dict[str, Module] = {}
     for path in iter_py_files(paths):
         try:
             rel = path.resolve().relative_to(root.resolve()).as_posix()
         except ValueError:
             rel = path.as_posix()
         try:
-            module = Module(path, rel, path.read_text())
+            module = _load_module(path, rel)
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             result.errors.append(f"{rel}: unparsable: {e}")
             continue
+        modules.append(module)
+        by_rel[module.rel] = module
+
+    graph = CallGraph(modules)
+
+    def file_findings(checker, module):
+        for finding in checker.check(module):
+            yield module, finding
+
+    def program_findings(checker):
+        # several roots can reach one sink: report each line once
+        seen: Set[Tuple[str, str, int, int]] = set()
+        for finding in checker.check_program(graph):
+            key = (finding.rule, finding.path, finding.line, finding.col)
+            if key in seen:
+                continue
+            seen.add(key)
+            module = by_rel.get(finding.path)
+            if module is not None:
+                yield module, finding
+
+    try:
         for checker in active.values():
-            for finding in checker.check(module):
+            checker.program = graph
+        for checker in active.values():
+            if checker.whole_program:
+                produced = program_findings(checker)
+            else:
+                produced = (
+                    pair
+                    for module in modules
+                    for pair in file_findings(checker, module)
+                )
+            for module, finding in produced:
                 pragma = module.suppressed(finding)
                 if pragma is not None:
                     result.suppressed.append(finding)
+                    result.suppressed_pragmas.append(pragma)
                     continue
                 if baseline is not None:
                     ctx = module.line_text(finding.line)
@@ -295,5 +417,15 @@ def run_paths(
                         result.baselined.append(finding)
                         continue
                 result.findings.append(finding)
+    finally:
+        for checker in active.values():
+            checker.program = None
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.stats = {
+        "files": len(modules),
+        "functions": len(graph.functions),
+        "edges": graph.n_edges,
+        "rules": len(active),
+        "elapsed_ms": round((time.monotonic() - t0) * 1000.0, 1),
+    }
     return result

@@ -1,4 +1,4 @@
-"""Device-value taint analysis (function-local, syntactic).
+"""Device-value taint analysis (syntactic, per-function + summaries).
 
 ZT01/ZT02 must tell ``np.asarray(qs)`` (input coercion of a host list)
 apart from ``np.asarray(self._merge(self.state))`` (a device→host pull).
@@ -8,19 +8,25 @@ when it is built from
 
 - the aggregator state (any attribute chain rooted at ``self.state`` or
   a bare ``state`` name — the pytree every compiled program takes),
-- a ``jax.*`` / ``jnp.*`` call (device arrays are born there), or
+- a ``jax.*`` / ``jnp.*`` call (device arrays are born there),
 - any call that RECEIVES a tainted argument (compiled programs are
   opaque callables like ``self._merge``; what flows in device-flavored
-  comes out device-flavored),
+  comes out device-flavored), or
+- a call whose callee the whole-program graph RESOLVES to a function
+  that returns a tainted value (``call_resolver`` — the cross-module
+  summary hook :meth:`CallGraph.returns_tainted` plugs in, so a device
+  pull can no longer hide one helper call away in another module),
 
 propagated through names: assignment / tuple-unpack / for-targets of a
 tainted value taint the bound names. Two passes over the statement list
 approximate a fixpoint (enough for loops that bind before use).
 
-Deliberately syntactic and local: a checker needs NO false negatives on
-the shapes that caused real regressions (multi-``np.asarray`` reads of
-program outputs) and LOW false positives on host-only numpy code — it
-does not chase taint across function boundaries.
+Deliberately syntactic: a checker needs NO false negatives on the
+shapes that caused real regressions (multi-``np.asarray`` reads of
+program outputs) and LOW false positives on host-only numpy code. The
+per-function pass stays local; interprocedural flow comes in ONLY via
+summaries over resolved call-graph edges, which keeps the fallback
+(name-keyed) edges from smearing taint onto unrelated host code.
 """
 
 from __future__ import annotations
@@ -63,10 +69,15 @@ def _is_state_chain(node: ast.AST) -> bool:
 
 
 class FunctionTaint:
-    """Taint facts for one function body (nested defs included)."""
+    """Taint facts for one function body (nested defs included).
 
-    def __init__(self, fn: ast.AST) -> None:
+    ``call_resolver`` is an optional ``Call node -> bool`` oracle: when
+    the local rules don't taint a call, the resolver may (cross-module
+    summary: the resolved callee returns a device value)."""
+
+    def __init__(self, fn: ast.AST, call_resolver=None) -> None:
         self.fn = fn
+        self.call_resolver = call_resolver
         self.tainted_names: Set[str] = set()
         body = getattr(fn, "body", [])
         for _ in range(2):  # two passes ≈ fixpoint for name-level flow
@@ -140,6 +151,8 @@ class FunctionTaint:
             if any(self.is_tainted(a) for a in node.args):
                 return True
             if any(self.is_tainted(k.value) for k in node.keywords):
+                return True
+            if self.call_resolver is not None and self.call_resolver(node):
                 return True
             return False
         if isinstance(node, ast.BinOp):

@@ -201,7 +201,7 @@ class CritPathLedger:
 
     # -- slot lifecycle (main process only) -------------------------------
 
-    def alloc(self, pid: int, widx: int, wire_t0_ns: int) -> int:
+    def alloc(self, pid: int, widx: int, wire_t0_ns: int) -> int:  # zt-lint: disable=ZT11 — the slot is FREE (invisible to readers) until the trailing _OFF_STATE=_ST_OPEN store publishes it; interval counts are RESET here, not mutated under readers, so no gen bracket applies
         """Claim a slot for payload ``pid`` routed to worker ``widx``.
         Returns -1 (trace skipped, counted) when the ledger is full."""
         with self._lock:
@@ -390,12 +390,12 @@ def set_active(ledger: Optional[CritPathLedger], slot: int, pid: int) -> None:
     _active.group = None
 
 
-def set_active_group(ledger: Optional[CritPathLedger], pairs) -> None:
+def set_active_group(ledger: Optional[CritPathLedger], pairs) -> None:  # zt-dispatch-critical: arms the coalesced-flush timeline map on the dispatch core
     """Arm ``stamp_active`` for a COALESCED flush: ``pairs`` is a list of
     ``(slot, pid)`` timelines sharing one device/WAL interval. Each
     traced member gets the same stamped wall window — the flush really
     did serve all of them at once, so per-timeline conservation holds."""
-    pairs = [(s, p) for s, p in pairs if s >= 0]
+    pairs = [(s, p) for s, p in pairs if s >= 0]  # zt-lint: disable=ZT09 — per traced group MEMBER (≤ coalesce_max), tuple filter only
     _active.ledger = ledger if pairs else None
     _active.slot = -1
     _active.pid = -1

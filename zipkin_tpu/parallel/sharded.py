@@ -702,7 +702,7 @@ class ShardedAggregator:
         n_dur: int,
         n_err: int,
         ts_range=None,
-    ) -> None:
+    ) -> None:  # zt-dispatch-critical: the per-chunk device entry point — one device_put + one fused jitted step under the state lock
         """Fold one PRE-ROUTED packed wire image ``[shards, 11, per]``
         into the state — the entry point for producers that already hold
         the wire format (the multi-process parse tier, WAL replay). The
@@ -768,6 +768,7 @@ class ShardedAggregator:
                 )
                 self._resident.append((lo, hi, self._shard_cursor.copy()))
                 self._shard_cursor = self._shard_cursor + live_per_shard
+            # zt-lint: disable=ZT09 — per RETIRED resident range (ring-wrap bookkeeping, one pop per overwritten batch), never per span
             while self._resident and (
                 (self._shard_cursor - self._resident[0][2]).min()
                 >= self.config.ring_capacity
@@ -783,7 +784,7 @@ class ShardedAggregator:
                 c["sampledKept"] += kept_b
                 c["sampledDropped"] += seen_b - kept_b
                 if self.wal_hook is not None:
-                    compacted = self.sampler.compact_fused(fused, keep2d)
+                    compacted = self.sampler.compact_fused(fused, keep2d)  # zt-lint: disable=ZT09 — per SHARD (mesh-sized) fancy-index gather; the per-lane work inside is vectorized
                     if compacted is not None:
                         cf, k_spans, k_dur, k_err, k_ts = compacted
                         self.wal_seq = self.wal_hook(

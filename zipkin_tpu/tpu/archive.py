@@ -148,6 +148,16 @@ def parsed_record(parsed) -> Optional[tuple]:
     )
 
 
+def _fsync_dir(directory: str) -> None:
+    """Make a rename in ``directory`` durable (same chokepoint idiom as
+    snapshot.py / timetier.py — the dir entry itself needs the fsync)."""
+    dfd = os.open(directory or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def _presence_bits(vals: np.ndarray) -> np.ndarray:
     """8KB bitmap of which u16 ids occur (ids >= 2^16 are the caller's
     overflow flag — the archive packs svc/rsvc into 16 bits, names can
@@ -250,7 +260,10 @@ class _Segment:
                 tmp = path + ".meta.npz.tmp"
                 with open(tmp, "wb") as f:
                     np.savez_compressed(f, **self.meta)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, path + ".meta.npz")
+                _fsync_dir(os.path.dirname(path))
             except OSError:  # read-only dir etc.: scan without skipping
                 pass
         # a retained fd: reads survive retention's unlink (queries that
@@ -774,6 +787,7 @@ class SpanArchive:
             self.spans_quarantined += n
             for suffix in ("", ".ids.npy", ".cols.npy", ".meta.npz"):
                 try:
+                    # zt-lint: disable=ZT12 — quarantine moves already-corrupt bytes ASIDE; the poison file's durability is not a recovery invariant (a lost rename just re-quarantines next boot)
                     os.replace(
                         seg.path + suffix, seg.path + suffix + ".quarantine"
                     )

@@ -31,7 +31,7 @@ from zipkin_tpu.tpu.state import (
 )
 
 
-def lane_bucket(lanes: int, pad_to_multiple: int, cap: int) -> int:
+def lane_bucket(lanes: int, pad_to_multiple: int, cap: int) -> int:  # zt-dispatch-critical: shape-bucket pick on the coalesced dispatch path
     """Static-shape bucket for a coalesced multi-chunk lane count.
 
     The coalesced dispatch path (span ring, mp_ingest) concatenates N
@@ -44,7 +44,7 @@ def lane_bucket(lanes: int, pad_to_multiple: int, cap: int) -> int:
     (valid=0), the same safe-pad invariant the router relies on.
     """
     b = max(1, int(pad_to_multiple))
-    while b < lanes:
+    while b < lanes:  # zt-lint: disable=ZT09 — doubling ladder: ≤ log2(cap/pad)+1 trips, independent of span count
         b *= 2
     return min(b, cap) if cap >= lanes else b
 
@@ -499,6 +499,7 @@ def dependency_links(
     ring-sort half — the aggregator caches one per state version.
     """
     if ctx is None:
+        # zt-lint: disable=ZT07 — dead branch on the fresh path: spmd_edges_fresh always passes the delta ctx (fresh_link_context); this fallback serves warm-read/test callers where the full rebuild is the point
         ctx = linker.link_context(ring_link_input(state))
     in_window = (state.r_ts_min >= ts_lo) & (state.r_ts_min <= ts_hi)
     calls, errors = linker.emit_links(

@@ -837,13 +837,13 @@ class MultiProcessIngester:
         ready: List[tuple] = []
         consumed: Dict[int, int] = {}
         self._pump(ready, consumed)
-        occ = self._ring.occupancy()
+        occ = self._ring.occupancy()  # zt-lint: disable=ZT09 — O(n_workers) stripe-depth word reads
         if occ > self._ring_high:
             self._ring_high = occ
         if ready:
             self._flush_ready(ready)
         if consumed:
-            self._materialize_views()
+            self._materialize_views()  # zt-lint: disable=ZT09 — per straddling-payload CHUNK copy, bounded by stripe depth × workers, not span count
             # zt-lint: disable=ZT09 — per worker STRIPE with consumed slots
             for w, cnt in consumed.items():
                 for _ in range(cnt):  # zt-lint: disable=ZT09 — per consumed SLOT (chunk-sized), a word store + counter bump each
@@ -854,7 +854,7 @@ class MultiProcessIngester:
             dead = [w for w in self._reap_later if w not in eof_set]
             self._reap_later = []
             if dead:
-                self._reap_dead_workers(dead, eof_set)
+                self._reap_dead_workers(dead, eof_set)  # zt-lint: disable=ZT09 — rare worker-death recovery path, trips per dead worker / inflight payload, not steady-state dispatch
                 activity = True
         # zt-lint: disable=ZT09 — per EOF-pending WORKER, two integer reads
         for w in list(self._pending_eof):
@@ -1184,7 +1184,7 @@ class MultiProcessIngester:
         for e, pid in group:  # zt-lint: disable=ZT09 — per CHUNK (max_batch-sized); all per-span work inside is vectorized
             fused, c_spans, c_dur, c_err, ts_range, arch, rec, _c, is_view, widx = e
             if arch:
-                self._archive(arch)
+                self._archive(arch)  # zt-lint: disable=ZT09 — per archive SLICE = the 1-in-N sampled raw spans; decode/gate IS the retention surface, bounded by the sampling rate
             if rec is not None and getattr(store, "_disk", None) is not None:
                 # sampling gate: the fused sketch feed below always sees
                 # 100% of spans; only raw-archive retention is gated.
@@ -1194,7 +1194,7 @@ class MultiProcessIngester:
                 # path's dispatch-ordered gate.
                 sampler = store.agg.sampler
                 if sampler is not None:
-                    rec = sampler.gate_record(rec)
+                    rec = sampler.gate_record(rec)  # zt-lint: disable=ZT09 — vectorized verdict; the per-kept-span byte compaction runs only when spans are gated away, on ONE record
                 if rec is not None:
                     store.disk_append_record(rec)
             if self.shadow is not None:
@@ -1257,7 +1257,7 @@ class MultiProcessIngester:
                 done.append(pid)
         return done
 
-    def _ack_done(self, pids: List[int], plans: Dict[int, dict]) -> None:
+    def _ack_done(self, pids: List[int], plans: Dict[int, dict]) -> None:  # zt-dispatch-critical: post-durability ack fan-in on the dispatch core — O(payloads per pass)
         """Ack payloads whose last chunk is durable: counters, metrics,
         ledger ack, inflight release. Runs after the group flush — and
         after the vectored WAL commit when one covered the pass."""

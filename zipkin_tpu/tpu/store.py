@@ -912,7 +912,7 @@ class TpuStorage(
         lo_min = epoch_minutes(request.end_ts - request.lookback)
         hi_min = epoch_minutes(request.end_ts)
 
-        def scan_candidates(cand_limit: int) -> Tuple[List[List[Span]], bool]:
+        def fetch(cand_limit: int) -> Tuple[List[List[Span]], bool]:
             # ONE view snapshot for the whole query: the live segment
             # sorts its rows when a view is taken, so per-trace
             # re-snapshots would re-sort per candidate
@@ -983,11 +983,11 @@ class TpuStorage(
             )
             return out[: request.limit], len(cands) >= cand_limit
 
-        results, capped = scan_candidates(request.limit * 4 + 16)
+        results, capped = fetch(request.limit * 4 + 16)
         if capped and len(results) < request.limit:
             # the post-filter starved the limit inside the first scan
             # window: widen once before settling for fewer results
-            results, _ = scan_candidates((request.limit * 4 + 16) * 8)
+            results, _ = fetch((request.limit * 4 + 16) * 8)
         return results
 
     def get_service_names(self) -> Call[List[str]]:
