@@ -29,6 +29,14 @@ are set for a single box; raise with env vars for full-scale runs:
             gRPC retry-delay trailers), zero acked loss at durable
             parity, disk-full degrades (not crashes) and clears, B0
             back within one long window of flood end.
+  config9 — tenant flood containment gate: tenant B floods >=3x its
+            ingest budget through the real HTTP boundary (X-Tenant-Id)
+            while A and C stay in budget; every shed is B's and
+            tenant-scoped (X-Shed-Scope/X-Shed-Tenant + per-tenant
+            Retry-After, gRPC shed-scope trailers), A/C hold ack and
+            query SLOs at global B0, per-tenant acked attribution is
+            exact, zero acked loss across mid-flood crash-resume, and
+            the {tenant=} prometheus families render.
 
 Run: python -m evals.run_configs [config0 config1 ...]
 """
@@ -1718,9 +1726,339 @@ def config8() -> bool:
     return ok
 
 
+def config9() -> bool:
+    """Tenant flood containment gate (ISSUE 18): three tenants share
+    one server; tenant B floods >=3x its per-tenant ingest budget
+    through the real HTTP boundary (``X-Tenant-Id`` header) while A and
+    C stay inside theirs. The gate:
+
+    - every 429 is B's, carries ``X-Shed-Scope: tenant`` /
+      ``X-Shed-Tenant: B`` and Retry-After guidance derived from B's
+      own bucket deficit; A and C are never shed,
+    - A/C wire-to-ack p99 and mid-flood query p99 stay inside SLO, and
+      the GLOBAL brownout ladder never leaves B0 (zero transitions) —
+      containment, not degradation,
+    - per-tenant admission posture: B at level >=2, A and C at 0,
+      visible on /statusz and as ``{tenant=}`` prometheus families,
+    - per-tenant acked attribution through the fan-out tier is exact
+      (mpTenantTable spans == per * that tenant's 202s),
+    - a gRPC Report as B over a real channel sheds RESOURCE_EXHAUSTED
+      with ``shed-scope: tenant`` trailing metadata,
+    - zero acked-span loss for every tenant across a MID-flood
+      crash-resume (cold boot between flood waves replays exactly the
+      acked set) and again at flood end,
+    - calm ticks return B to level 0 within one long SLO window.
+    """
+    import asyncio
+    import tempfile
+
+    import grpc
+    import grpc.aio
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from zipkin_tpu.model import json_v2, proto3
+    from zipkin_tpu.model.span import Endpoint, Span
+    from zipkin_tpu.runtime.tenant import TENANT_HEADER
+    from zipkin_tpu.server.app import ZipkinServer
+    from zipkin_tpu.server.config import ServerConfig
+    from zipkin_tpu.server.grpc import METHOD, GrpcCollectorServer
+    from zipkin_tpu.storage.tpu import TpuStorage
+    from zipkin_tpu.tpu.state import AggConfig
+
+    # queue capacity comfortably above concurrent offered load: the
+    # per-tenant budget must be the ONLY control that sheds here
+    workers, depth = 2, 16
+    per = int(os.environ.get("EVAL_TENANT_SPANS_PER", 40))
+    n_flood = int(os.environ.get("EVAL_TENANT_FLOOD_N", 16))
+    n_calm_posts = 3
+    ack_slo_ms = float(os.environ.get("EVAL_TENANT_ACK_SLO_MS", 250.0))
+    query_slo_ms = float(os.environ.get("EVAL_TENANT_QUERY_SLO_MS", 250.0))
+    long_window_ticks = 300
+    cfg = dict(max_services=64, max_keys=256, hll_precision=8,
+               digest_centroids=16, digest_buffer=1 << 14,
+               ring_capacity=1 << 14, link_buckets=4, hist_slices=2)
+
+    def spans_for(i, n):
+        ep = Endpoint.create(service_name=f"svc{i % 8}", ip="10.0.0.1")
+        return [
+            Span.create(
+                trace_id=f"{0xE900_0000 + i:016x}",
+                id=f"{(i << 16) + j + 1:016x}",
+                name=f"op{j % 8}",
+                timestamp=1_753_000_000_000_000 + i * 1000 + j,
+                duration=500 + j, local_endpoint=ep,
+            )
+            for j in range(n)
+        ]
+
+    # size B's budget off the real wire payload: burst = 4 payloads, so
+    # a 16-payload burst is a 4x flood while A/C's 3 stay inside
+    body_len = len(json_v2.encode_span_list(spans_for(0, per)))
+    budget_bytes_per_s = 4.0 * body_len
+
+    def revive_spans(tmp):
+        """Cold boot from the live server's WAL/ckpt dirs: the acked
+        set a crash at this instant would replay to."""
+        revived = TpuStorage(
+            config=AggConfig(**cfg), num_devices=1, batch_size=512,
+            checkpoint_dir=os.path.join(tmp, "ckpt"),
+            wal_dir=os.path.join(tmp, "wal"),
+        )
+        n = int(revived.agg.host_counters["spans"])
+        revived.close()
+        return n
+
+    async def scenario(tmp) -> dict:
+        storage = TpuStorage(
+            config=AggConfig(**cfg), num_devices=1, batch_size=512,
+            checkpoint_dir=os.path.join(tmp, "ckpt"),
+            wal_dir=os.path.join(tmp, "wal"),
+        )
+        server = ZipkinServer(
+            ServerConfig(storage_type="tpu", tpu_fast_ingest=True,
+                         tpu_mp_workers=workers, tpu_mp_queue_depth=depth,
+                         obs_windows_enabled=False,
+                         tenant_ingest_bytes_per_s=budget_bytes_per_s,
+                         tenant_ingest_burst_s=1.0,
+                         tenant_flood_ratio=2.0, tenant_dwell_ticks=3),
+            storage=storage,
+        )
+        ctl = server._overload
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            seq = iter(range(1, 1 << 20))
+
+            async def post(tenant):
+                i = next(seq)
+                t0 = time.perf_counter()
+                resp = await client.post(
+                    "/api/v2/spans",
+                    data=json_v2.encode_span_list(spans_for(i, per)),
+                    headers={"Content-Type": "application/json",
+                             TENANT_HEADER: tenant},
+                )
+                await resp.release()
+                return (tenant, resp.status, dict(resp.headers),
+                        (time.perf_counter() - t0) * 1000.0)
+
+            async def query():
+                t0 = time.perf_counter()
+                resp = await client.get("/api/v2/services")
+                await resp.release()
+                return (resp.status,
+                        (time.perf_counter() - t0) * 1000.0)
+
+            async def wave():
+                posts = (
+                    [post("B") for _ in range(n_flood)]
+                    + [post("A") for _ in range(n_calm_posts)]
+                    + [post("C") for _ in range(n_calm_posts)]
+                )
+                queries = [query() for _ in range(8)]
+                out = await asyncio.gather(*posts, *queries)
+                return out[:len(posts)], out[len(posts):]
+
+            results, queries = await wave()
+            await asyncio.to_thread(server._mp_ingester.drain)
+            acked_so_far = per * sum(
+                1 for r in results if r[1] == 202
+            )
+            # mid-flood crash-resume: cold boot between flood waves
+            durable_parity_mid = (
+                await asyncio.to_thread(revive_spans, tmp)
+            ) == acked_so_far
+
+            res2, q2 = await wave()  # the flood resumes post-"crash"
+            results += res2
+            queries += q2
+            await asyncio.to_thread(server._mp_ingester.drain)
+
+            by = {
+                t: [r for r in results if r[0] == t]
+                for t in ("A", "B", "C")
+            }
+            sheds = [r for r in results if r[1] == 429]
+            guided = [
+                r for r in sheds
+                if r[2].get("X-Shed-Scope") == "tenant"
+                and r[2].get("X-Shed-Tenant") == "B"
+                and int(r[2].get("Retry-After", 0)) >= 1
+                and int(r[2].get("X-Retry-After-Ms", 0)) > 0
+            ]
+            ac_ack_ms = [r[3] for t in ("A", "C") for r in by[t]
+                         if r[1] == 202]
+            ack_p99_ms = (float(np.percentile(ac_ack_ms, 99))
+                          if ac_ack_ms else None)
+            q_ms = [ms for st, ms in queries if st == 200]
+            query_p99_ms = (float(np.percentile(q_ms, 99))
+                            if len(q_ms) == len(queries) else None)
+
+            acked_n = {t: sum(1 for r in by[t] if r[1] == 202)
+                       for t in by}
+            mp_table = server._mp_ingester.stats()["mpTenantTable"]
+            attribution_exact = all(
+                mp_table.get(t, {}).get("spans", 0) == per * acked_n[t]
+                for t in ("A", "B", "C")
+            )
+
+            # aggregate posture AT flood peak: feed the ladder the real
+            # fan-out queue saturation — containment means it stays B0
+            stats = server._mp_ingester.stats()
+            qsat = max(
+                row["queueDepth"] for row in stats["mpWorkerTable"]
+            ) / depth
+            ctl.evaluate({"critpathQueueSaturation": qsat})
+            c = ctl.counters()
+            global_b0 = (c["overloadLevel"] == 0
+                         and c["overloadTransitions"] == 0)
+            levels = {t: c.get(f"tenantLevel_{t}") for t in ("A", "B", "C")}
+
+            statusz = (
+                await (await client.get("/api/v2/tpu/statusz")).json()
+            )
+            statusz_b_level = (
+                statusz["overload"]["tenants"]["tenants"]["B"]["level"]
+            )
+            prom = await (await client.get("/prometheus")).text()
+            prom_lines = [
+                ln for ln in prom.splitlines()
+                if ln.startswith("zipkin_tpu_tenant_") and "{" in ln
+            ]
+            prom_ok = (
+                any('zipkin_tpu_tenant_level{tenant="B"}' in ln
+                    for ln in prom_lines)
+                and any('tenant="A"' in ln for ln in prom_lines)
+                and all(
+                    len(ln.rsplit(" ", 1)) == 2
+                    and float(ln.rsplit(" ", 1)[1]) >= 0.0
+                    for ln in prom_lines
+                )
+            )
+
+            # gRPC twin: Report AS B over a real channel while B's
+            # bucket is dry — big payloads so refill cannot outrun the
+            # probe loop; an admitted probe is budget headroom working
+            grpc_guided = False
+            grpc_admitted_spans = 0
+            gsrv = GrpcCollectorServer(server.collector,
+                                       host="127.0.0.1", port=0)
+            await gsrv.start()
+            try:
+                async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{gsrv.port}"
+                ) as ch:
+                    method = ch.unary_unary(METHOD)
+                    for k in range(6):
+                        n = per * 2
+                        try:
+                            await method(
+                                proto3.encode_span_list(
+                                    spans_for(0x9100 + k, n)
+                                ),
+                                metadata=(("x-tenant-id", "B"),),
+                            )
+                            grpc_admitted_spans += n
+                        except grpc.aio.AioRpcError as err:
+                            md = {key: v for key, v in
+                                  (err.trailing_metadata() or ())}
+                            grpc_guided = (
+                                err.code()
+                                == grpc.StatusCode.RESOURCE_EXHAUSTED
+                                and md.get("shed-scope") == "tenant"
+                                and md.get("shed-tenant") == "B"
+                                and int(md.get("retry-delay-ms", 0)) > 0
+                            )
+                            break
+            finally:
+                await gsrv.stop()
+            await asyncio.to_thread(server._mp_ingester.drain)
+
+            acked_spans = (
+                per * sum(acked_n.values()) + grpc_admitted_spans
+            )
+            device_parity = \
+                int(storage.agg.host_counters["spans"]) == acked_spans
+            durable_parity = (
+                await asyncio.to_thread(revive_spans, tmp)
+            ) == acked_spans
+
+            # calm: pressure decays tick-by-tick, the bucket refills in
+            # real time — pace the ticks so both can happen
+            ticks_to_calm = None
+            for t in range(1, long_window_ticks + 1):
+                ctl.evaluate({"critpathQueueSaturation": 0.0})
+                c = ctl.counters()
+                if (c["overloadLevel"] == 0
+                        and c.get("tenantLevel_B", 0) == 0):
+                    ticks_to_calm = t
+                    break
+                await asyncio.sleep(0.02)
+
+            return {
+                "budget_payloads_per_burst": 4,
+                "b_offered_over_budget": round(n_flood / 4.0, 1),
+                "acked": {t: acked_n[t] for t in ("A", "B", "C")},
+                "shed": len(sheds),
+                "sheds_tenant_scoped_to_b": len(guided),
+                "a_c_sheds": sum(
+                    1 for t in ("A", "C") for r in by[t] if r[1] == 429
+                ),
+                "ac_ack_p99_ms": ack_p99_ms and round(ack_p99_ms, 2),
+                "query_p99_ms": (query_p99_ms
+                                 and round(query_p99_ms, 2)),
+                "attribution_exact": attribution_exact,
+                "global_stays_b0": global_b0,
+                "tenant_levels": levels,
+                "statusz_b_level": statusz_b_level,
+                "prom_tenant_families_ok": prom_ok,
+                "grpc_shed_guided": grpc_guided,
+                "device_parity": device_parity,
+                "durable_parity_mid_flood": durable_parity_mid,
+                "durable_parity": durable_parity,
+                "calm_ticks_to_level0": ticks_to_calm,
+            }
+        finally:
+            await client.close()
+            await server.stop()
+
+    with tempfile.TemporaryDirectory(prefix="eval_config9_") as tmp:
+        r = asyncio.run(scenario(tmp))
+    ok = bool(
+        r["b_offered_over_budget"] >= 3.0
+        and r["acked"]["A"] == 2 * n_calm_posts
+        and r["acked"]["C"] == 2 * n_calm_posts
+        and r["a_c_sheds"] == 0
+        and r["acked"]["B"] >= 1 and r["shed"] >= 1
+        and r["acked"]["B"] + r["shed"] == 2 * n_flood
+        and r["sheds_tenant_scoped_to_b"] == r["shed"]
+        and r["ac_ack_p99_ms"] is not None
+        and r["ac_ack_p99_ms"] <= ack_slo_ms
+        and r["query_p99_ms"] is not None
+        and r["query_p99_ms"] <= query_slo_ms
+        and r["attribution_exact"]
+        and r["global_stays_b0"]
+        and r["tenant_levels"]["B"] >= 2
+        and r["tenant_levels"]["A"] == 0
+        and r["tenant_levels"]["C"] == 0
+        and r["statusz_b_level"] >= 2
+        and r["prom_tenant_families_ok"]
+        and r["grpc_shed_guided"]
+        and r["device_parity"]
+        and r["durable_parity_mid_flood"] and r["durable_parity"]
+        and r["calm_ticks_to_level0"] is not None
+        and r["calm_ticks_to_level0"] <= long_window_ticks
+    )
+    _emit(config="config9", passed=ok, ack_slo_ms=ack_slo_ms,
+          query_slo_ms=query_slo_ms,
+          long_window_ticks=long_window_ticks, **r)
+    return ok
+
+
 ALL = {"config0": config0, "config1": config1, "config2": config2,
        "config3": config3, "config4": config4, "config5": config5,
-       "config6": config6, "config7": config7, "config8": config8}
+       "config6": config6, "config7": config7, "config8": config8,
+       "config9": config9}
 
 
 def main() -> None:

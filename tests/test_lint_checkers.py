@@ -10,6 +10,7 @@ must produce nothing, so the finding provably comes from that checker.
 from __future__ import annotations
 
 import textwrap
+from pathlib import Path
 
 from zipkin_tpu.lint import all_checkers, run_paths
 from zipkin_tpu.lint.cli import main as lint_main
@@ -1803,3 +1804,112 @@ def test_same_named_methods_on_different_classes_do_not_collide(tmp_path):
         """,
     )
     assert rules(result) == []
+
+# -- ZT14: tenant-admission coverage for ingest boundaries ---------------
+
+
+ZT14_COVERED = {
+    "app/http.py": """
+        from app import coll
+
+        def ingest(body):  # zt-ingest-boundary: HTTP spans POST
+            return coll.accept(body)
+    """,
+    "app/coll.py": """
+        def accept(body):
+            # zt-tenant-admission: tenant budget before parse/dispatch
+            return len(body)
+    """,
+}
+
+
+def test_zt14_clean_when_boundary_reaches_chokepoint(tmp_path):
+    result = lint_tree(tmp_path, ZT14_COVERED)
+    assert rules(result) == []
+
+
+def test_zt14_flags_boundary_that_bypasses_admission(tmp_path):
+    # the quiet-bypass shape: a second transport hands bytes straight
+    # to the fan-out tier without ever traversing admission
+    files = dict(ZT14_COVERED)
+    files["app/udp.py"] = """
+        from app import fanout
+
+        def ingest_udp(body):  # zt-ingest-boundary: UDP spans datagram
+            return fanout.submit(body)
+    """
+    files["app/fanout.py"] = """
+        def submit(body):
+            return len(body)
+    """
+    result = lint_tree(tmp_path, files)
+    assert rules(result) == ["ZT14"]
+    assert "ingest_udp" in result.findings[0].message
+    clean = lint_tree(tmp_path, files, ignore={"ZT14"})
+    assert rules(clean) == []
+
+
+def test_zt14_follows_to_thread_callable_reference(tmp_path):
+    # the real boundary shape: the handler hops threads by REFERENCE
+    # (asyncio.to_thread(self.collector.accept, ...)) — a Call-edge-only
+    # walk would break the chain here and false-positive the boundary
+    result = lint_tree(
+        tmp_path,
+        {
+            "app/http.py": """
+                import asyncio
+
+                class Server:
+                    async def ingest(self, body):  # zt-ingest-boundary: HTTP spans POST
+                        await asyncio.to_thread(self.collector.accept, body)
+            """,
+            "app/coll.py": """
+                class Collector:
+                    def accept(self, body):
+                        # zt-tenant-admission: tenant budget before dispatch
+                        return len(body)
+            """,
+        },
+    )
+    assert rules(result) == []
+
+
+def test_zt14_marker_without_reason_is_flagged(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "app/http.py": """
+                def ingest(body):  # zt-ingest-boundary
+                    return accept(body)
+
+                def accept(body):
+                    # zt-tenant-admission: tenant budget before dispatch
+                    return len(body)
+            """,
+        },
+    )
+    assert rules(result) == ["ZT14"]
+    assert "reason" in result.findings[0].message
+
+
+def test_zt14_no_chokepoint_at_all_flags_every_boundary(tmp_path):
+    result = lint_tree(
+        tmp_path,
+        {
+            "app/http.py": """
+                def ingest(body):  # zt-ingest-boundary: HTTP spans POST
+                    return len(body)
+            """,
+        },
+    )
+    assert rules(result) == ["ZT14"]
+    assert "no zt-tenant-admission chokepoint" in result.findings[0].message
+
+
+def test_zt14_real_tree_boundaries_are_covered():
+    # the live wiring, not a fixture: both wire entrypoints (HTTP
+    # _ingest, gRPC report) must reach a marked admission chokepoint in
+    # the repo's own call graph — this is the gate the satellite ships
+    repo = Path(__file__).resolve().parents[1]
+    result = run_paths([str(repo / "zipkin_tpu")], root=repo)
+    assert "ZT14" not in rules(result)

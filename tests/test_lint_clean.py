@@ -52,7 +52,7 @@ def test_lint_package_lints_itself_clean():
 def test_full_rule_catalog_registered():
     assert sorted(all_checkers()) == [
         "ZT00", "ZT01", "ZT02", "ZT03", "ZT04", "ZT05", "ZT06", "ZT07",
-        "ZT08", "ZT09", "ZT10", "ZT11", "ZT12", "ZT13",
+        "ZT08", "ZT09", "ZT10", "ZT11", "ZT12", "ZT13", "ZT14",
     ]
 
 

@@ -177,23 +177,43 @@ class Collector:
         that is an HTTP 400 or a poison-pill skip) — after counting the
         dropped message.
         """
+        # zt-tenant-admission: the collector chokepoint — tenant budget
+        # first (scope tenant), then the global brownout ladder (scope
+        # global), before any parse or device dispatch
         self.metrics.increment_messages()
         self.metrics.increment_bytes(len(data))
+        from zipkin_tpu.runtime.tenant import CURRENT_TENANT
+
+        tenant = CURRENT_TENANT.get()
         ctl = self.overload
         if ctl is not None:
-            # brownout admission (ISSUE 13): B2 sheds bulk payloads
-            # probabilistically, B3 admits the error class only. The
-            # verdict precedes every parse/queue path so a shed costs
-            # one substring probe, and the refusal is explicit — the
-            # sender gets a retryable rejection, never a dropped ack.
+            # admission (ISSUEs 13/18): the tenant's own token bucket is
+            # consulted first — a flooding tenant sheds alone while
+            # everyone else rides B0 — then the global ladder (B2 sheds
+            # bulk payloads probabilistically, B3 admits the error class
+            # only). The verdict precedes every parse/queue path so a
+            # shed costs one substring probe, and the refusal is
+            # explicit — the sender gets a retryable rejection carrying
+            # scope + per-scope backoff guidance, never a dropped ack.
             from zipkin_tpu.tpu.mp_ingest import IngestBackpressure
 
-            admitted, cls = ctl.admit_ingest(data)
-            if not admitted:
+            v = ctl.admit(data, tenant=tenant)
+            if not v.admitted:
                 self.metrics.increment_messages_dropped()
+                if v.scope == "tenant":
+                    msg = (
+                        f"tenant {v.tenant!r} over ingest budget: "
+                        f"{v.cls} payload shed; retry after the "
+                        "advertised backoff"
+                    )
+                else:
+                    msg = (
+                        f"overload {ctl.level_name}: {v.cls} payload "
+                        "shed; retry after the advertised backoff"
+                    )
                 raise IngestBackpressure(
-                    f"overload {ctl.level_name}: {cls} payload shed; "
-                    "retry after the advertised backoff"
+                    msg, scope=v.scope, tenant=v.tenant,
+                    retry_after_s=v.retry_after_s or None,
                 )
         try:
             # resource-exhaustion injection (faults.py): an allocation
@@ -238,7 +258,9 @@ class Collector:
                     # non-blocking at the boundary: a full tier must
                     # surface as 429/RESOURCE_EXHAUSTED, not as the
                     # event loop's to_thread pool silently queueing
-                    self.mp_ingester.submit(data, block=False)
+                    self.mp_ingester.submit(
+                        data, block=False, tenant=tenant
+                    )
                 except IngestBackpressure:
                     self.metrics.increment_messages_dropped()
                     raise

@@ -67,6 +67,17 @@ exhaustion degrades to an explicitly-flagged at-risk mode with an SLO
 page — never a crash, never a silent ack — and clearing the fault
 restores normal operation with bit-identical query state.
 
+A resource site can additionally target ONE tenant (ISSUE 18):
+``arm_resource(site, tenant="B")`` or
+``ZT_RESOURCE=feed.latency:tenant=B`` fires only on traversals
+attributed to that tenant — either the explicit ``tenant=`` argument
+the call site passes (the fan-out dispatcher knows its chunk's
+tenant), or the ambient ``CURRENT_TENANT`` contextvar at boundary
+sites. Non-matching traversals do NOT consume ``nth``/``count``, so a
+fault armed for tenant B stays armed through any amount of A/C
+traffic — the deterministic per-tenant injection the isolation tests
+(tests/test_tenant.py, EVALS config9) are built on.
+
 The disarmed fast path is one dict probe, so production code keeps the
 hooks compiled in; a site is one-shot — it disarms itself as it fires
 so crash/scrub *handling* code can re-enter the same path.
@@ -133,7 +144,8 @@ class CrashpointTriggered(RuntimeError):
 _armed: Dict[str, List] = {}
 # site -> [remaining_nth, mode]; mutated in place by corrupt_point()
 _corrupt_armed: Dict[str, List] = {}
-# site -> [remaining_nth, remaining_count, latency_s]; resource_point()
+# site -> [remaining_nth, remaining_count, latency_s, tenant|None];
+# mutated in place by resource_point()
 _resource_armed: Dict[str, List] = {}
 
 
@@ -161,16 +173,20 @@ def arm_corrupt(site: str, mode: str = "flip", nth: int = 1) -> None:
 
 
 def arm_resource(site: str, nth: int = 1, count: int = 1,
-                 latency_ms: float = 25.0) -> None:
+                 latency_ms: float = 25.0,
+                 tenant: Optional[str] = None) -> None:
     """Arm a resource site: starts failing on its ``nth`` traversal and
     keeps failing for ``count`` consecutive traversals (0 = until
-    ``disarm()``), modeling sustained exhaustion that later clears."""
+    ``disarm()``), modeling sustained exhaustion that later clears.
+    ``tenant`` scopes the fault to one tenant's traversals (ISSUE 18);
+    other tenants pass through without consuming nth/count."""
     if site not in RESOURCE_SITES:
         raise ValueError(
             f"unknown resource site {site!r} (see faults.RESOURCE_SITES)"
         )
     _resource_armed[site] = [
-        max(1, int(nth)), max(0, int(count)), max(0.0, latency_ms) / 1000.0
+        max(1, int(nth)), max(0, int(count)), max(0.0, latency_ms) / 1000.0,
+        tenant or None,
     ]
 
 
@@ -253,14 +269,27 @@ def corrupt_point(site: str, path: str, start: int, length: int) -> bool:
     return True
 
 
-def resource_point(site: str) -> None:
+def resource_point(site: str, tenant: Optional[str] = None) -> None:
     """Hot-path hook for exhaustion sites. No-op (one dict probe)
     unless armed. Disk sites raise ``OSError(ENOSPC)``, ``alloc``
     raises ``MemoryError``, ``feed.latency`` sleeps and returns — the
-    caller's normal error handling IS the behavior under test."""
+    caller's normal error handling IS the behavior under test.
+
+    When the armed spec names a tenant, only that tenant's traversals
+    fire (and count): ``tenant`` is the caller's explicit attribution,
+    falling back to the ambient ``CURRENT_TENANT`` contextvar at
+    boundary sites where the request context is still live."""
     spec = _resource_armed.get(site)
     if spec is None:
         return
+    want = spec[3] if len(spec) > 3 else None
+    if want is not None:
+        if tenant is None:
+            # lazy import: faults must stay importable before runtime/
+            from zipkin_tpu.runtime.tenant import CURRENT_TENANT
+            tenant = CURRENT_TENANT.get()
+        if tenant != want:
+            return  # other tenants pass through, nth/count untouched
     if spec[0] > 1:
         spec[0] -= 1  # not yet at the nth traversal
         return
@@ -321,14 +350,21 @@ def _arm_from_env() -> None:
             if not spec:
                 continue
             parts = spec.split(":")
+            tenant = None
+            pos = []
+            for p in parts[1:]:
+                p = p.strip()
+                if p.startswith("tenant="):
+                    tenant = p[len("tenant="):] or None
+                elif p:
+                    pos.append(p)
             try:
                 arm_resource(
                     parts[0].strip(),
-                    int(parts[1]) if len(parts) > 1 and parts[1].strip()
-                    else 1,
-                    int(parts[2]) if len(parts) > 2 and parts[2].strip()
-                    else 1,
+                    int(pos[0]) if len(pos) > 0 else 1,
+                    int(pos[1]) if len(pos) > 1 else 1,
                     latency_ms=lat_ms,
+                    tenant=tenant,
                 )
             except ValueError as e:
                 logger.warning("ignoring %s=%r: %s", ENV_RESOURCE, raw, e)

@@ -46,9 +46,15 @@ Rule catalog (grounded in real past regressions — see ARCHITECTURE.md
   module depth over the whole-program call graph — from
   ``# zt-mirror-served`` and ``# zt-reader-process`` entrypoints (the
   static gate for the ROADMAP's multi-process read front end).
+- ZT14 tenant admission: every ``# zt-ingest-boundary`` wire
+  entrypoint must reach a ``# zt-tenant-admission`` chokepoint in the
+  whole-program call graph (callable-reference hops like
+  ``asyncio.to_thread(f, ...)`` included) — a transport that hands
+  bytes to the fan-out tier without traversing admission silently
+  breaks tenant isolation (ISSUE 18).
 
-ZT07/ZT08/ZT13 walk the shared whole-program call graph built once per
-run (``lint/callgraph.py``: qualified-name resolution, bounded-depth
+ZT07/ZT08/ZT13/ZT14 walk the shared whole-program call graph built once
+per run (``lint/callgraph.py``: qualified-name resolution, bounded-depth
 reachability, cross-module taint summaries); ZT01/ZT02/ZT04/ZT09/ZT10
 consult it per module for summaries, caller proofs, and callee hops.
 """
@@ -66,5 +72,6 @@ from zipkin_tpu.lint.checkers import (  # noqa: F401 - import registers
     readeriso,
     recompile,
     seqlock,
+    tenantadm,
     transfers,
 )

@@ -102,7 +102,7 @@ _WORKER_SEGS = frozenset((SEG_PARSE, SEG_SLOT_WAIT, SEG_PACK, SEG_ROUTE,
 # header | calibration rows (main + one per worker) | slots
 #
 # slot: [state gen_d pid widx wire_t0 ack_t open_t flags n_d
-#        d_intervals(3*MAX_D) gen_w n_w w_intervals(3*MAX_W)]
+#        d_intervals(3*MAX_D) gen_w n_w w_intervals(3*MAX_W) tenant]
 # The main-side region (gen_d guards pid..d_intervals) and the worker
 # region (gen_w guards n_w..w_intervals) have disjoint writers, so each
 # keeps the single-writer seqlock invariant even while a worker packs
@@ -126,7 +126,10 @@ _OFF_D_IV = 9
 _OFF_GEN_W = _OFF_D_IV + 3 * MAX_D_IV
 _OFF_N_W = _OFF_GEN_W + 1
 _OFF_W_IV = _OFF_N_W + 1
-SLOT_WORDS = _OFF_W_IV + 3 * MAX_W_IV
+# tenant intern idx (ISSUE 18): written once at alloc while the slot is
+# still FREE (invisible), so it needs no gen bracket of its own
+_OFF_TENANT = _OFF_W_IV + 3 * MAX_W_IV
+SLOT_WORDS = _OFF_TENANT + 1
 
 _HDR_WORDS = 8
 _CAL_WORDS = 4          # [gen, perf_counter_ns, time_ns, pad]
@@ -201,7 +204,7 @@ class CritPathLedger:
 
     # -- slot lifecycle (main process only) -------------------------------
 
-    def alloc(self, pid: int, widx: int, wire_t0_ns: int) -> int:  # zt-lint: disable=ZT11 — the slot is FREE (invisible to readers) until the trailing _OFF_STATE=_ST_OPEN store publishes it; interval counts are RESET here, not mutated under readers, so no gen bracket applies
+    def alloc(self, pid: int, widx: int, wire_t0_ns: int, tenant: int = 0) -> int:  # zt-lint: disable=ZT11 — the slot is FREE (invisible to readers) until the trailing _OFF_STATE=_ST_OPEN store publishes it; interval counts are RESET here, not mutated under readers, so no gen bracket applies
         """Claim a slot for payload ``pid`` routed to worker ``widx``.
         Returns -1 (trace skipped, counted) when the ledger is full."""
         with self._lock:
@@ -217,6 +220,7 @@ class CritPathLedger:
         a[b + _OFF_PID] = pid
         a[b + _OFF_WIDX] = widx
         a[b + _OFF_WIRE_T0] = wire_t0_ns
+        a[b + _OFF_TENANT] = tenant
         a[b + _OFF_ACK_T] = 0
         a[b + _OFF_FLAGS] = 0
         a[b + _OFF_OPEN_T] = _now_ns()
@@ -627,6 +631,7 @@ class CritPathStitcher:
             "durs_us": durs_us,
             "pid": int(blk[_OFF_PID]),
             "widx": widx,
+            "tenant": int(blk[_OFF_TENANT]),
             "wire_ns": wire,
             "ack_ns": ack,
             "intervals": ivs,
@@ -673,6 +678,7 @@ class CritPathStitcher:
                 "obs.critpath.conservation": "%.3f" % tl["conservation"],
                 "obs.critpath.pid": str(tl["pid"]),
                 "obs.critpath.worker": str(tl["widx"]),
+                "obs.critpath.tenant": str(tl.get("tenant", 0)),
                 "obs.critpath.queue_wait_us":
                     str(tl["durs_us"][SEG_QUEUE_WAIT]),
             },

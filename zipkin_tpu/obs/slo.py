@@ -166,6 +166,27 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
     ]
 
 
+def tenant_specs(tenant: str, short_s: float = 60.0, long_s: float = 300.0,
+                 burn_threshold: float = 2.0,
+                 objective: float = 0.99) -> List[SloSpec]:
+    """Tenant-scoped SLOs (ISSUE 18): shed ratio over ONE tenant's own
+    offered/shed counters (published per-tenant by the admission table
+    via the overload controller's counter export), so tenant A's error
+    budget cannot be consumed by tenant B's flood — the SLO twin of the
+    isolation property itself. Instantiated per TPU_TENANT_SLO entry
+    using the same PR 9 grammar as :func:`default_specs`; counter name
+    suffixes use the tenant's prometheus-safe slug."""
+    from zipkin_tpu.runtime.tenant import tenant_slug
+
+    slug = tenant_slug(tenant)
+    kw = dict(short_s=short_s, long_s=long_s, burn_threshold=burn_threshold)
+    return [
+        SloSpec(f"tenant_{slug}_shed_ratio", "ratio", objective=objective,
+                bad=f"tenantShed_{slug}", total=f"tenantOffered_{slug}",
+                **kw),
+    ]
+
+
 class SloWatchdog:
     """Evaluates specs against a :class:`WindowedTelemetry` plane."""
 
@@ -185,6 +206,16 @@ class SloWatchdog:
         self.on_trip: List = []
         if subscribe:
             windows.on_tick(lambda _w: self.evaluate())
+
+    def add_spec(self, spec: SloSpec) -> None:
+        """Register one more spec after construction (tenant-scoped
+        instances, ISSUE 18). Idempotent by name — re-adding an
+        existing spec is a no-op, so wiring code can be re-entered."""
+        with self._lock:
+            if any(s.name == spec.name for s in self.specs):
+                return
+            self.specs.append(spec)
+            self._alerts.setdefault(spec.name, False)
 
     # -- burn math -----------------------------------------------------
 

@@ -34,10 +34,20 @@ into survival behavior (ISSUE 13):
     durability path as B0 traffic — a shed is an explicit 429 /
     RESOURCE_EXHAUSTED with backoff guidance, never a silent 2xx.
 
-- **Backoff guidance**: sheds carry a retry delay derived from the
-  live load index (jittered so a synchronized retry storm decorrelates)
-  — surfaced as HTTP ``Retry-After`` and gRPC ``retry-delay`` trailing
-  metadata by the server boundary.
+- **Backoff guidance**: sheds carry a retry delay and a SCOPE. A
+  global shed's delay derives from the live load index (jittered so a
+  synchronized retry storm decorrelates); a tenant shed's delay derives
+  from that tenant's own bucket deficit, not global load. Both surface
+  as HTTP ``Retry-After`` / ``X-Shed-Scope`` and gRPC ``retry-delay`` /
+  ``shed-scope`` trailing metadata at the server boundary, so a client
+  can distinguish "you are being limited" from "the system is browning
+  out".
+- **Tenant fold** (ISSUE 18): when a :class:`TenantAdmission` table is
+  attached, :meth:`admit` consults the offending tenant's budget FIRST
+  — a flooding tenant is driven to B2/B3-style admission on its own
+  while every other tenant (and this global ladder) stays B0. The
+  global ladder engages only when aggregate signals — HBM, WAL fsync,
+  queue saturation — trip, exactly as before.
 - **Provability**: ladder state, load index, per-class admit/shed
   counters, and the transition history publish to ``/metrics``,
   ``/prometheus`` (``zipkin_tpu_overload_*``), and the statusz
@@ -117,6 +127,10 @@ class OverloadController:
         self.retry_base_s = float(retry_base_s)
         self.retry_cap_s = float(retry_cap_s)
         self.rate_controller = rate_controller
+        # per-tenant budget table (runtime/tenant.py); admit() consults
+        # it first so a flooding tenant sheds alone while the global
+        # ladder stays wherever the aggregate signals put it
+        self.tenant_admission = None
         self._clock = clock
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -151,6 +165,7 @@ class OverloadController:
         self.admitted_essential = 0
         self.shed_bulk = 0
         self.shed_total = 0
+        self.shed_tenant = 0
         self.deadline_expired = 0
         self.ticks = 0
         self.history: collections.deque = collections.deque(maxlen=history)
@@ -220,6 +235,12 @@ class OverloadController:
                 self.ema_alpha * raw + (1.0 - self.ema_alpha) * self._load
             )
             event = self._step_locked()
+        ta = self.tenant_admission
+        if ta is not None:
+            try:
+                ta.tick()
+            except Exception:
+                pass
         if event is not None:
             for cb in list(self.on_transition):
                 try:
@@ -303,14 +324,65 @@ class OverloadController:
         """Cheap value-class probe over unparsed payload bytes."""
         return CLASS_ERROR if _ERROR_PROBE in data else CLASS_BULK
 
+    def admit(self, data: bytes = b"", tenant: Optional[str] = None,
+              value_class: Optional[str] = None):
+        """Tenant-aware admission chokepoint: classify once, consult
+        the tenant's own budget first (scope ``tenant`` — everyone else
+        is unaffected), then the global brownout ladder (scope
+        ``global``). Returns an :class:`AdmitVerdict` carrying the
+        scope and per-scope Retry-After guidance, so the boundary can
+        tell a limited tenant apart from a browning-out system.
+        """
+        # zt-tenant-admission: single chokepoint every boundary-
+        # reachable ingest path must traverse before device dispatch
+        from zipkin_tpu.runtime.tenant import (
+            DEFAULT_TENANT, AdmitVerdict,
+        )
+
+        t = tenant if tenant else DEFAULT_TENANT
+        ta = self.tenant_admission
+        cls = value_class
+        if cls is None:
+            # classify only when someone will act on the class — the
+            # substring probe is cheap but not free at B0 line rate.
+            # An accounting-only tenant table (no byte budget, no
+            # retained table) can never shed, so it does not count.
+            ta_can_shed = (
+                ta is not None and ta.enabled
+                and (ta.bytes_per_s > 0 or ta.retained_table is not None)
+            )
+            if ta_can_shed or self._level >= B2:
+                cls = self.classify(data)
+            else:
+                cls = CLASS_BULK
+        if ta is not None and ta.enabled:
+            ok, retry = ta.admit(t, len(data), cls)
+            if not ok:
+                with self._lock:
+                    self.shed_tenant += 1
+                rc = self.rate_controller
+                if rc is not None:
+                    try:
+                        rc.note_pressure()
+                    except Exception:
+                        pass
+                return AdmitVerdict(False, cls, "tenant", t, retry)
+        admitted, cls = self.admit_ingest(data, value_class=cls)
+        if not admitted:
+            return AdmitVerdict(False, cls, "global", t,
+                                self.retry_after_s())
+        return AdmitVerdict(True, cls, "none", t, 0.0)
+
     def admit_ingest(self, data: bytes = b"",
                      value_class: Optional[str] = None) -> tuple:
-        """Admission verdict for one ingest payload: ``(admitted,
-        value_class)``. B0/B1 admit everything; B2 always admits the
-        error class and sheds bulk probabilistically (fractional-credit,
-        so the admit rate tracks the target exactly); B3 admits the
-        error class only. Every bulk shed nudges the sampling
-        controller's pressure hook."""
+        """GLOBAL-ladder admission verdict for one ingest payload:
+        ``(admitted, value_class)``. B0/B1 admit everything; B2 always
+        admits the error class and sheds bulk probabilistically
+        (fractional-credit, so the admit rate tracks the target
+        exactly); B3 admits the error class only. Every bulk shed
+        nudges the sampling controller's pressure hook. Tenant-scoped
+        budgets do NOT apply here — the boundary goes through
+        :meth:`admit`, which folds them in first."""
         cls = value_class if value_class is not None else (
             self.classify(data) if self._level >= B2 else CLASS_BULK
         )
@@ -362,10 +434,19 @@ class OverloadController:
 
     # -- backoff guidance ----------------------------------------------
 
-    def retry_after_s(self) -> float:
-        """Shed backoff: grows with the load index, jittered ±30% so a
-        synchronized client fleet decorrelates its retries instead of
-        re-flooding on one boundary."""
+    def retry_after_s(self, tenant: Optional[str] = None) -> float:
+        """Shed backoff. With a ``tenant`` and an attached tenant
+        table, guidance is that tenant's own bucket-refill horizon —
+        its load, not global load. Otherwise (global sheds) it grows
+        with the load index, jittered ±30% so a synchronized client
+        fleet decorrelates its retries instead of re-flooding on one
+        boundary."""
+        ta = self.tenant_admission
+        if tenant is not None and ta is not None and ta.enabled:
+            try:
+                return ta.retry_after_s(tenant)
+            except Exception:
+                pass
         base = self.retry_base_s * (
             1.0 + 4.0 * min(2.0, max(0.0, self._load))
             + 2.0 * self._level
@@ -377,7 +458,7 @@ class OverloadController:
 
     def counters(self) -> Dict[str, float]:
         """Scalar gauges for the /metrics merge."""
-        return {
+        out = {
             "overloadLevel": self._level,
             "overloadLoadIndex": round(self._load, 4),
             "overloadRawLoadIndex": round(self._raw_load, 4),
@@ -386,14 +467,30 @@ class OverloadController:
             "overloadAdmittedEssential": self.admitted_essential,
             "overloadShedBulk": self.shed_bulk,
             "overloadShedTotal": self.shed_total,
+            "overloadShedTenant": self.shed_tenant,
             "overloadObsShed": int(self.shed_observability()),
             "deadlineExpired": self.deadline_expired,
         }
+        ta = self.tenant_admission
+        if ta is not None:
+            try:
+                out.update(ta.counters())
+            except Exception:
+                pass
+        return out
 
     def status(self) -> Dict:
         """Full dict for the statusz ``overload`` section."""
+        ta = self.tenant_admission
+        tenants = None
+        if ta is not None:
+            try:
+                tenants = ta.status()
+            except Exception:
+                tenants = None
         with self._lock:
             return {
+                "tenants": tenants,
                 "level": self._level,
                 "levelName": LEVEL_NAMES[self._level],
                 "loadIndex": round(self._load, 4),
@@ -414,6 +511,7 @@ class OverloadController:
                     "admittedEssential": self.admitted_essential,
                     "shedBulk": self.shed_bulk,
                     "shedTotal": self.shed_total,
+                    "shedTenant": self.shed_tenant,
                     "deadlineExpired": self.deadline_expired,
                     "transitions": self.transitions,
                 },

@@ -17,6 +17,15 @@ Under throttle pressure (``note_pressure``: a batch the admission
 throttle rejected outright) the next interval tightens the effective
 budget, so sustained overload degrades into lower sampling rates — the
 graceful mode — instead of more rejections.
+
+Tenant budgets (ISSUE 18): :class:`TenantBudgetTable` tracks per-tenant
+retained-spans/sec token buckets, charged at dispatcher ack time (span
+counts are only known post-parse) and consulted by the admission
+chokepoint (``runtime/tenant.py``) so a tenant that retains beyond its
+budget is shed at the door with tenant-scoped guidance while the GLOBAL
+sampling budget — and every other tenant — is untouched. The table is
+bounded (LRU, evictions counted) so a hostile tenant-id stream cannot
+grow controller state.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ import logging
 import math
 import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -32,6 +42,114 @@ from zipkin_tpu import obs
 from zipkin_tpu.sampling import RATE_ONE
 
 logger = logging.getLogger(__name__)
+
+
+class TenantBudgetTable:
+    """Per-tenant retained-spans/sec token buckets (ISSUE 18).
+
+    One bucket per tenant over RETAINED spans — the durable cost a
+    tenant imposes downstream of sampling — refilled at
+    ``spans_per_s`` with ``spans_per_s * burst_s`` of burst headroom.
+    ``charge`` deducts at dispatcher ack time and may drive a bucket
+    negative (the spans are already retained; the debt throttles the
+    NEXT admission decision); ``over_budget`` is the read-only probe
+    the admission chokepoint consults before accepting more bytes from
+    that tenant.
+
+    Bounded: at most ``max_tenants`` rows, LRU-evicted (the "default"
+    tenant is never evicted — it anchors legacy traffic), evictions
+    counted — a hostile tenant-id stream cannot grow controller state.
+    ``spans_per_s <= 0`` disables enforcement (``over_budget`` is
+    always False) while still tallying per-tenant retained counts.
+    """
+
+    def __init__(
+        self,
+        spans_per_s: float = 0.0,
+        burst_s: float = 2.0,
+        max_tenants: int = 64,
+        clock=time.monotonic,
+    ) -> None:
+        self.spans_per_s = float(spans_per_s)
+        self.burst_s = float(burst_s)
+        self.max_tenants = max(1, int(max_tenants))
+        self.evictions = 0
+        self._clock = clock
+        self._lock = threading.Lock()
+        # tenant -> [tokens, last_refill, retained_total]
+        self._rows: "OrderedDict[str, list]" = OrderedDict()
+
+    @property
+    def enabled(self) -> bool:
+        return self.spans_per_s > 0.0
+
+    @property
+    def burst_spans(self) -> float:
+        return self.spans_per_s * self.burst_s
+
+    def _row(self, tenant: str) -> list:
+        row = self._rows.get(tenant)
+        if row is None:
+            while len(self._rows) >= self.max_tenants:
+                victim = next(
+                    (k for k in self._rows if k != "default"), None
+                )
+                if victim is None:
+                    break
+                self._rows.pop(victim)
+                self.evictions += 1
+            row = [self.burst_spans, self._clock(), 0]
+            self._rows[tenant] = row
+        else:
+            self._rows.move_to_end(tenant)
+        return row
+
+    def _refill(self, row: list) -> None:
+        now = self._clock()
+        dt = now - row[1]
+        if dt > 0:
+            row[0] = min(self.burst_spans, row[0] + dt * self.spans_per_s)
+            row[1] = now
+
+    def charge(self, tenant: str, n_spans: int) -> bool:
+        """Deduct ``n_spans`` retained spans from ``tenant``'s bucket;
+        returns True while the tenant stays within budget. May drive
+        the bucket negative — the debt gates future admission."""
+        with self._lock:
+            row = self._row(tenant)
+            row[2] += int(n_spans)
+            if not self.enabled:
+                return True
+            self._refill(row)
+            row[0] -= float(n_spans)
+            return row[0] >= 0.0
+
+    def over_budget(self, tenant: str) -> bool:
+        """Read-only probe: is this tenant's retained-spans bucket in
+        debt right now? Never creates a row."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            row = self._rows.get(tenant)
+            if row is None:
+                return False
+            self._refill(row)
+            return row[0] < 0.0
+
+    def retained(self, tenant: str) -> int:
+        with self._lock:
+            row = self._rows.get(tenant)
+            return int(row[2]) if row is not None else 0
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "tenantBudgetTableSize": len(self._rows),
+                "tenantBudgetEvictions": self.evictions,
+                "tenantRetainedTotal": sum(
+                    int(r[2]) for r in self._rows.values()
+                ),
+            }
 
 
 class RateController:
@@ -54,6 +172,9 @@ class RateController:
         self.pressure_events = 0
         self._pressure_pending = 0
         self.last_utilization = 0.0
+        # optional per-tenant retained-spans budgets (ISSUE 18); set by
+        # server wiring so tenant counters ride this controller's export
+        self.tenant_table: "TenantBudgetTable | None" = None
         self._thread = None
         self._stop = threading.Event()
 
@@ -181,4 +302,6 @@ class RateController:
             r = sampler.rate
             out["samplerRateMin"] = int(r.min()) / RATE_ONE
             out["samplerRateMean"] = float(r.mean()) / RATE_ONE
+        if self.tenant_table is not None:
+            out.update(self.tenant_table.counters())
         return out

@@ -40,6 +40,11 @@ from zipkin_tpu.internal.hex import normalize_trace_id
 from zipkin_tpu.model import codec, json_v2
 from zipkin_tpu.obs import critpath
 from zipkin_tpu.model.codec import Encoding
+from zipkin_tpu.runtime.tenant import (
+    CURRENT_TENANT,
+    TENANT_HEADER,
+    normalize_tenant,
+)
 from zipkin_tpu.server.config import ServerConfig
 from zipkin_tpu.storage.memory import InMemoryStorage
 from zipkin_tpu.storage.spi import QueryRequest, StorageComponent
@@ -426,6 +431,60 @@ class ZipkinServer:
                         **ev,
                     })
                 )
+            # tenant-isolated admission (runtime/tenant.py, ISSUE 18):
+            # per-tenant ingest budgets and tenant-scoped brownout
+            # levels folded by the controller each tick. Constructed
+            # even with a zero budget (accounting-only) so per-tenant
+            # counters and /statusz rows always publish; enforcement
+            # arms when TPU_TENANT_INGEST_BYTES_PER_S > 0.
+            if self.config.tenant_enabled:
+                from zipkin_tpu.runtime.tenant import TenantAdmission
+
+                retained_table = None
+                rc = getattr(core, "sampling_controller", None)
+                if self.config.tenant_retained_spans_per_s > 0:
+                    from zipkin_tpu.sampling.controller import (
+                        TenantBudgetTable,
+                    )
+
+                    # retained-spans/sec budget, charged at dispatcher
+                    # ack time (span counts are only known post-parse)
+                    # and consulted by admit() before accepting more
+                    # bytes from a tenant already in debt
+                    retained_table = TenantBudgetTable(
+                        spans_per_s=self.config.tenant_retained_spans_per_s,
+                        burst_s=self.config.tenant_ingest_burst_s,
+                        max_tenants=self.config.tenant_max,
+                    )
+                    if rc is not None:
+                        rc.tenant_table = retained_table
+                ta = TenantAdmission(
+                    bytes_per_s=self.config.tenant_ingest_bytes_per_s,
+                    burst_s=self.config.tenant_ingest_burst_s,
+                    max_tenants=self.config.tenant_max,
+                    flood_ratio=self.config.tenant_flood_ratio,
+                    dwell_ticks=self.config.tenant_dwell_ticks,
+                    retained_table=retained_table,
+                )
+                self._overload.tenant_admission = ta
+                if self._mp_ingester is not None:
+                    # the dispatcher attributes each acked payload's
+                    # span count back to its tenant (thread-safe sink)
+                    self._mp_ingester.tenant_sink = ta.note_retained
+                # tenant-scoped SLOs (PR 9 grammar): one shed-ratio
+                # spec per TPU_TENANT_SLO entry, evaluated over that
+                # tenant's own counters only
+                if self._obs_slo is not None and self.config.tenant_slo_tenants:
+                    from zipkin_tpu.obs.slo import tenant_specs
+
+                    for t in self.config.tenant_slo_tenants:
+                        for spec in tenant_specs(
+                            t,
+                            short_s=self.config.obs_slo_short_s,
+                            long_s=self.config.obs_slo_long_s,
+                            burn_threshold=self.config.obs_slo_burn_threshold,
+                        ):
+                            self._obs_slo.add_spec(spec)
         self.components: Dict[str, Component] = {self.config.storage_type: self.storage}
         self._runner: Optional[web.AppRunner] = None
         self._grpc = None
@@ -679,17 +738,33 @@ class ZipkinServer:
             headers={"X-Deadline-Expired": "1"},
         )
 
-    def _backoff_headers(self) -> Dict[str, str]:
-        """Retry guidance for a shed: jittered delay from the live load
-        index. ``Retry-After`` is RFC delta-seconds (integer, so ceil);
-        ``X-Retry-After-Ms`` preserves the jitter's precision."""
+    def _backoff_headers(self, exc=None) -> Dict[str, str]:
+        """Retry guidance for a shed: ``Retry-After`` is RFC
+        delta-seconds (integer, so ceil); ``X-Retry-After-Ms`` preserves
+        sub-second precision. When the shed carries a scope (ISSUE 18)
+        the delay is the one the rejecting control computed — a
+        tenant-budget shed advertises THAT tenant's bucket deficit, not
+        the global ladder's jittered backoff — and
+        ``X-Shed-Scope``/``X-Shed-Tenant`` say which control rejected
+        the payload."""
         if self._overload is None:
             return {}
-        delay_s = self._overload.retry_after_s()
-        return {
+        delay_s = getattr(exc, "retry_after_s", None)
+        scope = getattr(exc, "scope", None)
+        tenant = getattr(exc, "tenant", None)
+        if delay_s is None:
+            delay_s = self._overload.retry_after_s(
+                tenant if scope == "tenant" else None
+            )
+        headers = {
             "Retry-After": str(max(1, int(-(-delay_s // 1)))),
             "X-Retry-After-Ms": str(int(delay_s * 1000.0)),
         }
+        if scope:
+            headers["X-Shed-Scope"] = str(scope)
+        if tenant:
+            headers["X-Shed-Tenant"] = str(tenant)
+        return headers
 
     # -- ingest ------------------------------------------------------------
 
@@ -726,12 +801,22 @@ class ZipkinServer:
     async def post_spans_v1(self, request: web.Request) -> web.Response:
         return await self._ingest(request, v1=True)
 
+    # zt-ingest-boundary: HTTP POST /api/v{1,2}/spans is a wire
+    # entrypoint — tenant identity is extracted from X-Tenant-Id here,
+    # before the collector chokepoint runs admission
     async def _ingest(self, request: web.Request, *, v1: bool) -> web.Response:
         t0 = time.perf_counter()
         # critpath wire anchor: the same instant http_boundary measures
         # from, in the ns domain the interval ledger uses. Contextvars
         # survive asyncio.to_thread, so the MP submit path reads it.
         critpath.WIRE_T0_NS.set(int(t0 * 1e9))
+        # tenant admission identity (ISSUE 18): absent or hostile header
+        # values normalize to the default tenant, so legacy clients keep
+        # flowing; the collector chokepoint reads the contextvar (which
+        # survives asyncio.to_thread) for budget attribution
+        CURRENT_TENANT.set(
+            normalize_tenant(request.headers.get(TENANT_HEADER))
+        )
         try:
             body = await self._read_body(request)
         except PayloadTooLarge as e:
@@ -763,15 +848,17 @@ class ZipkinServer:
             # (reference behavior for RejectedExecutionException)
             return web.Response(status=503, text=str(e))
         except IngestBackpressure as e:
-            # every parse-worker queue in the fan-out tier is full, or
-            # the brownout ladder shed the payload: 429 (Too Many
-            # Requests) — transient, retryable, distinct from the
-            # throttle's 503 so dashboards can tell the tiers apart.
-            # Retry-After carries the controller's jittered backoff
-            # (RFC delta-seconds, so ceil); the millisecond twin keeps
-            # the jitter visible to clients that want to decorrelate.
+            # a tenant budget shed the payload, every parse-worker
+            # queue in the fan-out tier is full, or the global brownout
+            # ladder shed it: 429 (Too Many Requests) — transient,
+            # retryable, distinct from the throttle's 503 so dashboards
+            # can tell the tiers apart. Retry-After carries backoff
+            # scoped to whichever control rejected the payload
+            # (X-Shed-Scope: tenant|global, ISSUE 18); the millisecond
+            # twin keeps sub-second precision visible to clients that
+            # want to decorrelate.
             return web.Response(
-                status=429, text=str(e), headers=self._backoff_headers()
+                status=429, text=str(e), headers=self._backoff_headers(e)
             )
         # body read → collector hand-off complete; the 202 ack follows
         obs.record("http_boundary", time.perf_counter() - t0)
@@ -1059,6 +1146,14 @@ class ZipkinServer:
                 out.update(self.storage.ingest_counters())
             except Exception:
                 pass
+        # per-tenant admission counters (ISSUE 18): the windowed plane
+        # must see tenantOffered_<slug>/tenantShed_<slug> so the
+        # tenant-scoped shed-ratio SloSpecs can burn against them
+        if self._overload is not None:
+            try:
+                out.update(self._overload.counters())
+            except Exception:
+                pass
         return out
 
     def _windows_catch_up(self) -> None:
@@ -1287,7 +1382,11 @@ class ZipkinServer:
         # families — ladder posture, the folded signal set, admission
         # accounting, and deadline drops
         if self._overload is not None:
-            lines.extend(_prom_overload(self._overload.status()))
+            status = self._overload.status()
+            lines.extend(_prom_overload(status))
+            # tenant isolation (ISSUE 18): {tenant=}-labelled admission
+            # families, bounded by the tenant table's LRU cap
+            lines.extend(_prom_tenants(status))
         return web.Response(text="\n".join(lines) + "\n")
 
     async def get_tpu_statusz(self, request: web.Request) -> web.Response:
@@ -1748,6 +1847,56 @@ def _prom_overload(status) -> List[str]:
         lines.append(f"# HELP {fam} Overload controller: {help_text}.")
         lines.append(f"# TYPE {fam} counter")
         lines.append(f"{fam} {counters[field]}")
+    return lines
+
+
+def _prom_tenants(status) -> List[str]:
+    """Per-tenant admission families (ISSUE 18): every family carries a
+    ``{tenant=}`` label, so one flooding tenant's shed curve is
+    separable from everyone else's flat zero on the same graph. The
+    label values come from ``normalize_tenant``'s bounded alphabet, so
+    they are prometheus-label-safe by construction; the row count is
+    bounded by the admission table's LRU cap."""
+    tenants = (status or {}).get("tenants")
+    if not tenants:
+        return []
+    lines: List[str] = []
+    table = tenants.get("tenants") or {}
+    scalars = (
+        ("table_size", len(table),
+         "Live tenants in the bounded admission table", "gauge"),
+        ("evictions_total", tenants.get("evictions", 0),
+         "Tenant rows LRU-evicted from the admission table", "counter"),
+    )
+    for suffix, value, help_text, typ in scalars:
+        fam = f"zipkin_tpu_tenant_{suffix}"
+        lines.append(f"# HELP {fam} {help_text}.")
+        lines.append(f"# TYPE {fam} {typ}")
+        lines.append(f"{fam} {value}")
+    fields = (
+        ("level", "level",
+         "Per-tenant brownout level (0=admit .. 3=essential-only)",
+         "gauge"),
+        ("pressure", "pressure",
+         "Per-tenant demand pressure EMA (offered rate over budget)",
+         "gauge"),
+        ("offered", "offered_total", "payloads offered", "counter"),
+        ("admitted", "admitted_total", "payloads admitted", "counter"),
+        ("shed", "shed_total", "payloads shed (scope=tenant)", "counter"),
+        ("retainedSpans", "retained_spans_total",
+         "spans retained past sampling", "counter"),
+    )
+    for field, suffix, help_text, typ in fields:
+        fam = f"zipkin_tpu_tenant_{suffix}"
+        if typ == "counter":
+            lines.append(f"# HELP {fam} Tenant admission: {help_text}.")
+        else:
+            lines.append(f"# HELP {fam} {help_text}.")
+        lines.append(f"# TYPE {fam} {typ}")
+        for name, row in sorted(table.items()):
+            lines.append(
+                f'{fam}{{tenant="{_prom_label(name)}"}} {row[field]}'
+            )
     return lines
 
 

@@ -166,6 +166,28 @@ class ServerConfig:
     overload_dwell_ticks: int = 5
     overload_max_stale_ms: int = 5000
     overload_retry_base_s: float = 0.25
+    # tenant-isolated admission (runtime/tenant.py, ISSUE 18): every
+    # payload is attributed to the tenant named by X-Tenant-Id (HTTP) /
+    # x-tenant-id (gRPC metadata); absent or hostile ids collapse to the
+    # "default" tenant. When TPU_TENANT_INGEST_BYTES_PER_S > 0 each
+    # tenant gets its own token bucket over ingest bytes/sec (burst =
+    # rate * TPU_TENANT_INGEST_BURST_S) and a per-tenant brownout level:
+    # a flooding tenant is shed with tenant-scoped Retry-After guidance
+    # while every other tenant — and the GLOBAL ladder — stays at B0.
+    # TPU_TENANT_RETAINED_SPANS_PER_S (0 = off) adds a second budget
+    # over retained spans/sec, charged at dispatcher ack time through
+    # the sampling tier's per-tenant budget table. The tenant table is
+    # bounded (TPU_TENANT_MAX, LRU-evicted, evictions counted) so a
+    # hostile id stream cannot grow server state. TPU_TENANT_SLO lists
+    # tenants that get their own shed-ratio SloSpec instances.
+    tenant_enabled: bool = True
+    tenant_max: int = 64
+    tenant_ingest_bytes_per_s: float = 0.0
+    tenant_ingest_burst_s: float = 2.0
+    tenant_retained_spans_per_s: float = 0.0
+    tenant_flood_ratio: float = 2.0
+    tenant_dwell_ticks: int = 3
+    tenant_slo_tenants: Tuple[str, ...] = ()
     # epoch-published read mirror (tpu/mirror.py, ISSUE 14): the windows
     # ticker republishes the packed read-program outputs once per tick
     # (one aggregator-lock hold per epoch) and the query entrypoints
@@ -324,6 +346,20 @@ class ServerConfig:
             overload_retry_base_s=_env_float(
                 "TPU_OVERLOAD_RETRY_BASE_S", 0.25
             ),
+            tenant_enabled=_env_bool("TPU_TENANT", True),
+            tenant_max=_env_int("TPU_TENANT_MAX", 64),
+            tenant_ingest_bytes_per_s=_env_float(
+                "TPU_TENANT_INGEST_BYTES_PER_S", 0.0
+            ),
+            tenant_ingest_burst_s=_env_float(
+                "TPU_TENANT_INGEST_BURST_S", 2.0
+            ),
+            tenant_retained_spans_per_s=_env_float(
+                "TPU_TENANT_RETAINED_SPANS_PER_S", 0.0
+            ),
+            tenant_flood_ratio=_env_float("TPU_TENANT_FLOOD_RATIO", 2.0),
+            tenant_dwell_ticks=_env_int("TPU_TENANT_DWELL_TICKS", 3),
+            tenant_slo_tenants=_env_list("TPU_TENANT_SLO"),
             tpu_read_mirror=_env_bool("TPU_READ_MIRROR", True),
             tpu_mirror_max_stale_ms=_env_int(
                 "TPU_MIRROR_MAX_STALE_MS", 5000

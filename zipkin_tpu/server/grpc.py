@@ -39,6 +39,11 @@ from zipkin_tpu.collector.core import Collector
 from zipkin_tpu.model.codec import Encoding
 from zipkin_tpu.obs import critpath
 from zipkin_tpu.obs.selfspans import CURRENT_B3
+from zipkin_tpu.runtime.tenant import (
+    CURRENT_TENANT,
+    TENANT_METADATA_KEY,
+    normalize_tenant,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -65,24 +70,39 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
         self._collector = collector
         self._deadlines = deadlines
 
-    def _retry_trailers(self):
-        """Backoff guidance for a RESOURCE_EXHAUSTED shed (ISSUE 13):
-        the overload controller's jittered delay as ``retry-delay``
-        trailing metadata (seconds, decimal) — the gRPC twin of the
-        HTTP site's Retry-After header."""
+    def _retry_trailers(self, exc=None):
+        """Backoff guidance for a RESOURCE_EXHAUSTED shed (ISSUE 13/18):
+        the backoff delay as ``retry-delay`` trailing metadata (seconds,
+        decimal) — the gRPC twin of the HTTP site's Retry-After header.
+        When the shed carries a scope (tenant-budget vs global-ladder,
+        ISSUE 18) the trailers also say WHICH control rejected the
+        payload (``shed-scope``/``shed-tenant``) and the delay comes
+        from that tenant's own deficit, not the global ladder."""
         ctl = getattr(self._collector, "overload", None)
         if ctl is None:
             return None
-        delay_s = ctl.retry_after_s()
-        return (
+        delay_s = getattr(exc, "retry_after_s", None)
+        scope = getattr(exc, "scope", None)
+        tenant = getattr(exc, "tenant", None)
+        if delay_s is None:
+            delay_s = ctl.retry_after_s(tenant if scope == "tenant" else None)
+        trailers = [
             ("retry-delay", f"{delay_s:.3f}s"),
             ("retry-delay-ms", str(int(delay_s * 1000.0))),
-        )
+        ]
+        if scope:
+            trailers.append(("shed-scope", str(scope)))
+        if tenant:
+            trailers.append(("shed-tenant", str(tenant)))
+        return tuple(trailers)
 
     def service(self, handler_call_details):
         if handler_call_details.method != METHOD:
             return None
 
+        # zt-ingest-boundary: gRPC Report is a wire entrypoint — tenant
+        # identity is extracted from invocation metadata here, before the
+        # collector chokepoint runs admission
         async def report(request, context) -> bytes:
             t0_ns, data = request
             critpath.WIRE_T0_NS.set(t0_ns)
@@ -106,6 +126,13 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
             token = None
             if tid and sid and sampled not in ("0", "false"):
                 token = CURRENT_B3.set((tid, sid))
+            # tenant admission identity (ISSUE 18): lowercase metadata
+            # form of the HTTP X-Tenant-Id header; absent/hostile values
+            # normalize to the default tenant, so legacy clients keep
+            # flowing. contextvars survive asyncio.to_thread.
+            ten_tok = CURRENT_TENANT.set(
+                normalize_tenant(md.get(TENANT_METADATA_KEY))
+            )
             try:
                 # off the event loop: decode + device ingest block, and the
                 # loop is shared with the HTTP site (same fix as app.py)
@@ -115,16 +142,18 @@ class _SpanServiceHandler(grpc.GenericRpcHandler):
             except ValueError as e:
                 await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except Exception as e:
-                # storage rejection -> retryable; IngestBackpressure (the
-                # fan-out tier's bounded queues are full, or the brownout
-                # ladder shed the payload) lands here too, the gRPC twin
-                # of the HTTP site's 429 — trailing metadata carries the
-                # controller's backoff guidance
-                trailers = self._retry_trailers()
+                # storage rejection -> retryable; IngestBackpressure (a
+                # tenant-budget shed, the fan-out tier's bounded queues
+                # full, or the global brownout ladder) lands here too,
+                # the gRPC twin of the HTTP site's 429 — trailing
+                # metadata carries backoff guidance scoped to whichever
+                # control rejected the payload
+                trailers = self._retry_trailers(e)
                 if trailers is not None:
                     context.set_trailing_metadata(trailers)
                 await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             finally:
+                CURRENT_TENANT.reset(ten_tok)
                 if token is not None:
                     CURRENT_B3.reset(token)
             obs.record(

@@ -52,10 +52,15 @@ every live worker's delivery queue is full. The HTTP site maps it to 429
 and the gRPC site to RESOURCE_EXHAUSTED so senders back off instead of
 the tier buffering unboundedly. Since ISSUE 13 that rejection is the
 LAST backpressure surface, not the only one: the overload control plane
-(runtime/overload.py) sheds bulk-class payloads at the collector
-boundary first (B2/B3 brownout admission), tightens the sampling tier's
-budget under sustained pressure, and stamps every rejection with
-jittered backoff guidance (``Retry-After`` / ``retry-delay``).
+(runtime/overload.py) sheds at the collector boundary first — per-tenant
+budget sheds (scope ``tenant``: one flooding tenant is limited while
+everyone else rides B0) and then global B2/B3 brownout admission (scope
+``global``) — tightens the sampling tier's budget under sustained
+pressure, and stamps every rejection with backoff guidance AND its
+shedding scope (``Retry-After`` / ``X-Shed-Scope`` on HTTP,
+``retry-delay`` / ``shed-scope`` gRPC trailers). A saturation rejection
+from this tier is a global-scope shed: every tenant's traffic funnels
+through the same worker queues.
 
 Zero-loss worker death: the dispatcher retains every submitted payload
 (``_pending``) until its results are APPLIED, and buffers per-payload
@@ -113,12 +118,23 @@ class IngestBackpressure(RuntimeError):
     """The ingest tier refused a payload it could not absorb: every
     live parse worker's delivery queue is full — each backed up behind
     a congested ring stripe or a busy worker — in
-    ``submit(..., block=False)``, the brownout ladder shed it
-    (collector admission, ISSUE 13), or an injected allocation failure
-    fired. The server boundary maps it to HTTP 429 / gRPC
-    RESOURCE_EXHAUSTED — with the overload controller's jittered
-    backoff guidance attached — so senders back off and retry instead
-    of the tier buffering unboundedly."""
+    ``submit(..., block=False)``, the admission chokepoint shed it
+    (per-tenant budget or global brownout ladder, ISSUEs 13/18), or an
+    injected allocation failure fired. The server boundary maps it to
+    HTTP 429 / gRPC RESOURCE_EXHAUSTED — with backoff guidance and the
+    shedding ``scope`` attached, so a client can tell "you are being
+    limited" (scope ``tenant``, guidance from that tenant's own budget)
+    from "the system is browning out" (scope ``global``, guidance from
+    the load index) — and senders back off and retry instead of the
+    tier buffering unboundedly."""
+
+    def __init__(self, msg: str = "", *, scope: str = "global",
+                 tenant: Optional[str] = None,
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(msg)
+        self.scope = scope
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
 
 
 def _extract_archive_slices(parsed, every: int) -> List[bytes]:
@@ -176,7 +192,8 @@ def _worker_main(
     # journal cursors: how much of the local vocab has been reported
     sent_svc, sent_name, sent_pair = 1, 1, 1
 
-    def handle(pid: int, payload: bytes, state: dict, cslot: int) -> None:
+    def handle(pid: int, payload: bytes, state: dict, cslot: int,
+               tidx: int) -> None:
         nonlocal sent_svc, sent_name, sent_pair
         traced = cview is not None and cslot >= 0
         if traced:
@@ -298,6 +315,7 @@ def _worker_main(
                     parse_ns=int(parse_s * 1e9),
                     pack_ns=int(pack_s * 1e9),
                     route_ns=int(route_s * 1e9),
+                    tenant=tidx,
                     aux=aux,
                 )
                 # a ring publish carries no wakeup of its own: nudge
@@ -323,10 +341,10 @@ def _worker_main(
             item = work_q.get()
             if item is None:
                 break
-            pid, payload, cslot = item
+            pid, payload, cslot, tidx = item
             state: dict = {"completed": False}
             try:
-                handle(pid, payload, state, cslot)
+                handle(pid, payload, state, cslot, tidx)
             except Exception:  # pragma: no cover - keep the pool alive
                 logging.getLogger(__name__).exception(
                     "mp-ingest worker %d failed on a payload", widx
@@ -485,6 +503,20 @@ class MultiProcessIngester:
         # copied first — the tap may retain its argument past the slot's
         # reuse)
         self.shadow = None
+        # tenant attribution (ISSUE 18): a bounded intern table maps the
+        # boundary's tenant string to a small idx that rides the queue
+        # item, the ring slot header, and the critpath ledger. Overflow
+        # collapses onto idx 0 (the default tenant) — a hostile stream
+        # of unique tenant ids cannot grow this table unboundedly.
+        # tenant_sink (optional; called on the DISPATCHER thread at ack
+        # time, must be thread-safe) receives (tenant, n_spans) so the
+        # admission table can account retained-spans/sec budgets.
+        self._tenant_names: List[str] = ["default"]
+        self._tenant_ids: Dict[str, int] = {"default": 0}
+        self._tenant_max = 256
+        self._tenant_of: Dict[int, int] = {}
+        self._tenant_acked: Dict[str, Dict[str, int]] = {}
+        self.tenant_sink = None
         self.counters = {
             "accepted": 0, "sampleDropped": 0, "fallbacks": 0, "rejected": 0,
             "coalescedBatches": 0, "coalescedChunks": 0,
@@ -545,7 +577,27 @@ class MultiProcessIngester:
 
     # -- producer side ---------------------------------------------------
 
-    def submit(self, payload: bytes, *, block: bool = True) -> None:
+    def _tenant_idx(self, tenant: Optional[str]) -> int:
+        """Intern a boundary tenant id into the bounded idx table;
+        unknown tenants past the cap collapse onto the default idx 0."""
+        if not tenant or tenant == "default":
+            return 0
+        idx = self._tenant_ids.get(tenant)
+        if idx is not None:
+            return idx
+        with self._cv:
+            idx = self._tenant_ids.get(tenant)
+            if idx is not None:
+                return idx
+            if len(self._tenant_names) >= self._tenant_max:
+                return 0
+            idx = len(self._tenant_names)
+            self._tenant_names.append(tenant)
+            self._tenant_ids[tenant] = idx
+            return idx
+
+    def submit(self, payload: bytes, *, block: bool = True,
+               tenant: Optional[str] = None) -> None:
         """Enqueue a payload onto one live unsaturated worker.
 
         Registration happens BEFORE the queue put (under _cv, the same
@@ -554,8 +606,12 @@ class MultiProcessIngester:
         registration and refeeds the payload, or submit() sees the
         worker marked dead and picks another. A worker whose ring
         stripe is full is skipped exactly like one whose queue is full
-        — ring occupancy is the tier's backpressure basis.
+        — ring occupancy is the tier's backpressure basis. ``tenant``
+        (the boundary-extracted id) rides the queue item, the ring slot
+        header, and the critpath ledger so ack-time accounting stays
+        tenant-attributed end to end.
         """
+        tidx = self._tenant_idx(tenant)
         while True:
             if self._closed:
                 raise RuntimeError("ingester closed")
@@ -577,6 +633,8 @@ class MultiProcessIngester:
                 pid = self._next_pid
                 self._next_pid += 1
                 self._pending[pid] = payload
+                if tidx:
+                    self._tenant_of[pid] = tidx
                 self._inflight += 1
             wire_ns = (
                 _critpath.WIRE_T0_NS.get()
@@ -606,7 +664,9 @@ class MultiProcessIngester:
                     cslot = -1
                     if wire_ns:
                         t_en0 = time.perf_counter_ns()
-                        cslot = self._cp_ledger.alloc(pid, w, wire_ns)
+                        cslot = self._cp_ledger.alloc(
+                            pid, w, wire_ns, tenant=tidx
+                        )
                         if cslot >= 0:
                             # stamp + register BEFORE the queue put: the
                             # dispatcher only writes this slot after the
@@ -619,7 +679,9 @@ class MultiProcessIngester:
                             with self._cv:
                                 self._cslots[pid] = cslot
                     try:
-                        self._work_qs[w].put_nowait((pid, payload, cslot))
+                        self._work_qs[w].put_nowait(
+                            (pid, payload, cslot, tidx)
+                        )
                         with self._cv:
                             self._qdepth[w] += 1
                             if self._qdepth[w] > self._qhigh[w]:
@@ -641,6 +703,7 @@ class MultiProcessIngester:
                     return  # a racing reap consumed it
                 self._pending.pop(pid)
                 self._assigned.pop(pid, None)
+                self._tenant_of.pop(pid, None)
                 self._inflight -= 1
                 if self._inflight == 0:
                     self._cv.notify_all()
@@ -702,6 +765,12 @@ class MultiProcessIngester:
                  **dict(ws)}
                 for w, ws in enumerate(self._wstats)
             ],
+            # per-tenant acked attribution (ISSUE 18) — nested like the
+            # worker table; bounded by the tenant intern cap
+            "mpTenantTable": {
+                name: dict(row)
+                for name, row in self._tenant_acked.items()
+            },
         }
         if self.critpath is not None:
             out.update(self.critpath.counters())
@@ -936,6 +1005,17 @@ class MultiProcessIngester:
             # slot is still counted consumed and freed by the pass)
             self.counters["ringDiscarded"] += 1
             return
+        # tenant idx rides the slot header cross-process; the submit
+        # side already recorded it, but the ring word is authoritative
+        # for chunks (it survives even when attribution maps are cold)
+        tidx = int(hdr[ring_mod._S_TENANT])
+        if tidx and pid not in self._tenant_of:
+            # zt-lint: disable=ZT04 — single-writer-per-pid: submit()
+            # records the mapping under _cv BEFORE the worker can publish
+            # a chunk; this dispatcher-thread write only fills pids whose
+            # submit-side record was skipped (tidx==0 fast path), and no
+            # other thread touches that pid's key
+            self._tenant_of[pid] = tidx
         per = int(hdr[ring_mod._S_PER])
         fused = self._ring.image(
             w, seq, self._n_shards * self._wire_rows * per
@@ -1216,11 +1296,19 @@ class MultiProcessIngester:
         else:
             ts = (lo, hi) if lo is not None else (0, 0)
         tf0 = time.perf_counter()
-        # resource-fault injection (faults.py, ISSUE 13): an armed
+        # resource-fault injection (faults.py, ISSUE 13/18): an armed
         # feed.latency site sleeps here — the exact seam where a slow
         # device feed stalls the dispatcher — so overload tests can
-        # manufacture queue saturation deterministically
-        faults.resource_point("feed.latency")
+        # manufacture queue saturation deterministically. The group's
+        # tenant is passed explicitly (the dispatcher thread has no
+        # request context) so a tenant-scoped fault stalls only that
+        # tenant's dispatches.
+        g_tidx = self._tenant_of.get(group[0][1], 0) if group else 0
+        faults.resource_point(
+            "feed.latency",
+            tenant=self._tenant_names[g_tidx]
+            if 0 <= g_tidx < len(self._tenant_names) else "default",
+        )
         store.agg.ingest_fused_multi(
             parts, n_spans=n_spans, n_dur=n_dur, n_err=n_err,
             ts_range=ts, pad_to_multiple=store._pad,
@@ -1284,6 +1372,25 @@ class MultiProcessIngester:
             if cs >= 0:
                 # durable ack: the WAL append + device feed completed
                 self._cp_ledger.ack(cs, pid)
+            # per-tenant acked accounting + the retained-spans budget
+            # feed (ISSUE 18): span counts are only known post-parse,
+            # so retention budgets charge here, at ack time
+            tidx = self._tenant_of.get(pid, 0)
+            tname = (
+                self._tenant_names[tidx]
+                if 0 <= tidx < len(self._tenant_names) else "default"
+            )
+            ta = self._tenant_acked.setdefault(
+                tname, {"payloads": 0, "spans": 0}
+            )
+            ta["payloads"] += 1
+            ta["spans"] += total
+            sink = self.tenant_sink
+            if sink is not None and total:
+                try:
+                    sink(tname, total)
+                except Exception:  # accounting must never kill an ack
+                    logger.exception("tenant_sink failed")
             self._finish(pid)
 
     # -- worker death -----------------------------------------------------
@@ -1412,6 +1519,7 @@ class MultiProcessIngester:
             self._pending.pop(pid, None)
             w = self._assigned.pop(pid, None)
             self._cslots.pop(pid, None)
+            self._tenant_of.pop(pid, None)
             if w is not None and self._qdepth[w] > 0:
                 self._qdepth[w] -= 1
             self._inflight -= 1

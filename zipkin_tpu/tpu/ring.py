@@ -79,7 +79,8 @@ _S_PACK_NS = 14
 _S_ROUTE_NS = 15
 _S_AUX_LEN = 16
 _S_PUBLISH_NS = 17
-SLOT_HDR_WORDS = 18
+_S_TENANT = 18    # tenant intern idx (ISSUE 18); 0 = default tenant
+SLOT_HDR_WORDS = 19
 
 ST_FREE, ST_WRITING, ST_READY = 0, 1, 2
 
@@ -377,6 +378,7 @@ class RingProducer:
         pack_ns: int,
         route_ns: int,
         aux: bytes,
+        tenant: int = 0,
     ) -> None:
         """Fill the claimed slot's header + aux and make it visible:
         generation re-evened, state READY, then the head fence moves."""
@@ -406,6 +408,7 @@ class RingProducer:
         hdr[_S_ROUTE_NS] = route_ns
         hdr[_S_AUX_LEN] = len(aux)
         hdr[_S_PUBLISH_NS] = time.perf_counter_ns()
+        hdr[_S_TENANT] = tenant
         hdr[_S_GEN] += 1  # even: contents complete
         hdr[_S_STATE] = ST_READY
         self._advance_head()
