@@ -1,0 +1,57 @@
+"""Spawn targets for the serving chaos tests.
+
+A separate module so the spawn context's child re-import stays light:
+this file pulls in only stdlib + numpy (via the segment module) —
+never jax, never the store, never the test modules themselves. Each
+target is module-level (spawn requires a picklable import path).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import signal
+import time
+
+from zipkin_tpu.serving.segment import MirrorSegment, SegmentUnavailable
+
+
+def fuzz_reader(seg_params, reader_idx, stop_gen, out_q, barrier):
+    """Hammer read_frame against a live publisher until the segment
+    reaches ``stop_gen`` mirror generations; every decoded frame must
+    be internally consistent (payload {"g": N} == the header's
+    mirror_generation stamp) — a mismatch is a torn read the seqlock
+    failed to catch. Reports (reads, mismatches, unavailable)."""
+    seg = MirrorSegment.attach(seg_params)
+    reads = mismatches = unavailable = 0
+    try:
+        barrier.wait(timeout=30)
+        while True:
+            try:
+                fr = seg.read_frame(spins=200, spin_sleep_s=0.0005)
+            except SegmentUnavailable:
+                unavailable += 1
+                time.sleep(0.001)
+                continue
+            reads += 1
+            body = pickle.loads(fr.payload)
+            if body["g"] != fr.mirror_generation:
+                mismatches += 1
+            if fr.mirror_generation >= stop_gen:
+                break
+        out_q.put((reader_idx, reads, mismatches, unavailable))
+    finally:
+        seg.close()
+
+
+def demand_then_die(seg_params, reader_idx, n_keys, barrier):
+    """Push ``n_keys`` complete demand keys, sync, then SIGKILL self.
+    The demand ring's release-fence claim: a key is visible only once
+    its bytes are fully written and the head has advanced, so a child
+    killed at ANY instant leaves either a complete key or nothing —
+    never a torn one."""
+    seg = MirrorSegment.attach(seg_params)
+    for i in range(n_keys):
+        seg.demand_push(reader_idx, f"quant:digest:0.{i}")
+    barrier.wait(timeout=30)
+    os.kill(os.getpid(), signal.SIGKILL)

@@ -1760,6 +1760,59 @@ def test_zt13_clean_lock_free_serve_chain(tmp_path):
     assert rules(result) == []
 
 
+# ISSUE 19: the serving-tier shape — a reader-process entrypoint that
+# attaches the shm segment and serves through a view module. The whole
+# point of the process split is that NO path from the reader reaches
+# the aggregator lock; ZT13 is the static proof.
+
+ZT13_READER_ATTACH = {
+    "serving/reader.py": """
+        from serving import segment, view
+
+        def run_reader(params, idx, port):  # zt-reader-process: attaches the segment and serves
+            seg = segment.attach(params)
+            return view.serve(seg)
+    """,
+    "serving/segment.py": """
+        def attach(params):
+            return params
+    """,
+    "serving/view.py": """
+        def serve(seg):
+            return _rows(seg)
+
+        def _rows(seg):
+            return dict(seg.payload)
+    """,
+}
+
+
+def test_zt13_flags_lock_reached_through_shm_attach_path(tmp_path):
+    # the regression the marker exists to catch: a "stateless" reader
+    # whose view helper quietly reaches back into the ingest process's
+    # aggregator lock two modules below the attach call
+    files = dict(ZT13_READER_ATTACH)
+    files["serving/view.py"] = """
+        def serve(seg):
+            return _rows(seg)
+
+        def _rows(seg):
+            with seg.store.agg.lock:
+                return dict(seg.payload)
+    """
+    result = lint_tree(tmp_path, files)
+    assert rules(result) == ["ZT13"]
+    assert "run_reader" in result.findings[0].message
+    assert "via" in result.findings[0].message
+
+
+def test_zt13_clean_reader_attach_chain_passes(tmp_path):
+    # the shipped shape: attach → view → shaped rows, no lock anywhere
+    # on any path from the marked entrypoint
+    result = lint_tree(tmp_path, ZT13_READER_ATTACH)
+    assert rules(result) == []
+
+
 # -- the PR 15 collision class stays dead (graph-backed resolution) ------
 
 

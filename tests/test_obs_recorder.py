@@ -204,6 +204,6 @@ class TestTaxonomy:
             "coalesce", "mp_device_feed", "accuracy_rollup",
             "wire_to_durable",
             "query_lock_wait", "query_wall", "query_mirror",
-            "mirror_publish",
+            "mirror_publish", "reader_serve",
         }
         assert set(STAGES) == expected

@@ -1,0 +1,198 @@
+"""Chaos leg: reader processes die; nothing in the ingest path notices.
+
+The scale-out design's failure-isolation claim, exercised for real
+across process boundaries (spawn context, the `tests/test_ring.py`
+barrier idiom): a publisher floods epochs while reader processes
+hammer the seqlock — zero torn reads escape; a reader SIGKILLed
+mid-flood is respawned by the supervisor with zero failed ingest
+writes; a reader killed around a demand push leaves a complete key or
+nothing, never a torn one. Spawn targets live in
+`tests/serving_children.py` so the child re-import never pulls jax.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests import serving_children
+from tests.fixtures import lots_of_spans
+from tests.test_wal import make
+from zipkin_tpu.runtime.supervisor import RespawnBackoff
+from zipkin_tpu.serving.segment import MirrorSegment
+from zipkin_tpu.serving.supervisor import ReaderSupervisor
+
+FUZZ_GENS = 150
+N_READERS = 4
+
+
+def test_seqlock_fuzz_one_publisher_four_reader_processes():
+    """1 publisher + 4 reader processes at full contention: every frame
+    a reader decodes must carry the payload of the generation its
+    header stamps — the seqlock + CRC must let zero torn reads
+    through, and the flood must drop zero writes."""
+    ctx = mp.get_context("spawn")
+    seg = MirrorSegment(readers=N_READERS, capacity=1 << 16)
+    procs = []
+    try:
+        barrier = ctx.Barrier(N_READERS + 1)
+        out_q = ctx.Queue()
+        for idx in range(N_READERS):
+            p = ctx.Process(
+                target=serving_children.fuzz_reader,
+                args=(seg.params(), idx, FUZZ_GENS, out_q, barrier),
+                daemon=True,
+            )
+            p.start()
+            procs.append(p)
+        barrier.wait(timeout=60)  # all readers attached before the flood
+        for g in range(1, FUZZ_GENS + 1):
+            # payload size varies so buffers and CRCs churn
+            body = pickle.dumps(
+                {"g": g, "pad": b"x" * (64 + (g * 37) % 512)}, protocol=4
+            )
+            assert seg.write(body, mirror_generation=g, write_version=g), \
+                f"write dropped at generation {g}"
+        results = [out_q.get(timeout=60) for _ in range(N_READERS)]
+        for p in procs:
+            p.join(timeout=30)
+        assert sorted(r[0] for r in results) == list(range(N_READERS))
+        total_reads = sum(r[1] for r in results)
+        assert total_reads >= N_READERS  # everyone decoded frames
+        assert sum(r[2] for r in results) == 0, (
+            f"torn reads escaped the seqlock: {results}"
+        )
+        st = seg.status()
+        assert st["publishes"] == FUZZ_GENS and st["overflows"] == 0
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
+        seg.close()
+
+
+def test_demand_push_sigkill_leaves_complete_keys_or_nothing():
+    """The demand ring's release fence, proven by killing the pusher:
+    a child that pushed N keys and then took SIGKILL (barrier idiom —
+    the parent knows the pushes finished, the child never exits
+    cleanly) leaves exactly those N complete keys; the empty stripe of
+    a reader that never pushed stays empty."""
+    ctx = mp.get_context("spawn")
+    seg = MirrorSegment(readers=2, capacity=1 << 14)
+    try:
+        barrier = ctx.Barrier(2)
+        child = ctx.Process(
+            target=serving_children.demand_then_die,
+            args=(seg.params(), 0, 5, barrier),
+            daemon=True,
+        )
+        child.start()
+        barrier.wait(timeout=30)
+        child.join(timeout=30)
+        assert child.exitcode == -signal.SIGKILL
+        keys = seg.demand_drain()
+        assert keys == [f"quant:digest:0.{i}" for i in range(5)]
+        assert seg.demand_drain() == []  # stripe fully consumed, no tail
+    finally:
+        seg.close()
+
+
+def _health(port: int, timeout: float = 2.0):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=timeout
+        ) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return None
+
+
+def _wait_health(port: int, want: int, deadline_s: float = 45.0) -> bool:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if _health(port) == want:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+@pytest.mark.slow  # reader-process HTTP boot + flood: ~15-20 s
+def test_sigkill_reader_mid_flood_supervisor_respawns(tmp_path):
+    """SIGKILL a serving reader while ingest floods: the supervisor
+    respawns it (segment header carries the count), the replacement
+    serves again, and the ingest side records ZERO failed writes and
+    zero publish/sink errors — reader death is invisible to ingest."""
+    store = make(tmp_path, wal=False, checkpoint=False)
+    seg = MirrorSegment(readers=2, capacity=4 << 20)
+    sup = None
+    flood_errors = []
+    stop_flood = threading.Event()
+
+    def flood():
+        b = 0
+        while not stop_flood.is_set():
+            try:
+                store.span_consumer().accept(
+                    lots_of_spans(200, seed=100 + b, services=6,
+                                  span_names=8)
+                ).execute()
+                store.publish_mirror(force=True)
+            except Exception as e:  # any ingest failure is the bug
+                flood_errors.append(repr(e))
+                return
+            b += 1
+
+    try:
+        store.span_consumer().accept(
+            lots_of_spans(200, seed=99, services=6, span_names=8)
+        ).execute()
+        store.attach_mirror_segment(seg)
+        assert store.publish_mirror(force=True)
+        sup = ReaderSupervisor(
+            seg, 2, 19730, backoff=RespawnBackoff(base_s=0.05)
+        )
+        sup.start()
+        assert _wait_health(19730, 200) and _wait_health(19731, 200)
+
+        flooder = threading.Thread(target=flood, daemon=True)
+        flooder.start()
+
+        victim_pid = sup._children[0].pid
+        os.kill(victim_pid, signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while sup.respawns == 0 and time.monotonic() < deadline:
+            sup.poll()
+            time.sleep(0.05)
+        assert sup.respawns >= 1, "supervisor never respawned the victim"
+        assert sup._children[0].pid != victim_pid
+        # the replacement comes back up and serves
+        assert _wait_health(19730, 200), "respawned reader never served"
+
+        stop_flood.set()
+        flooder.join(timeout=60)
+        assert flood_errors == [], f"ingest writes failed: {flood_errors}"
+
+        counters = store.ingest_counters()
+        assert counters["segmentPublishErrors"] == 0
+        assert counters["mirrorSegmentSinkErrors"] == 0
+        assert counters["segmentOverflows"] == 0
+        st = sup.status()
+        assert st["respawns"] >= 1  # via the segment's supervisor words
+        assert st["publishes"] >= 2
+    finally:
+        stop_flood.set()
+        if sup is not None:
+            sup.stop()
+        seg.close()
+        store.close()

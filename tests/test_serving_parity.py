@@ -1,0 +1,286 @@
+"""Reader-vs-ingest byte parity through the shm mirror segment.
+
+The serving tier's correctness claim: a stateless reader process
+mapping the segment read-only produces, at a shared generation, the
+SAME BYTES as the ingest-process read path — for every endpoint, for
+tenant-prefixed keys, for windowed ``ttq:`` reads, and across a
+crash-resume boot publish. The publisher serializes the packed read
+outputs; `serving/shape.py` replicates the store's route selection and
+row shaping; these tests are the contract that keeps that replication
+honest. Staleness and demand semantics (503-never-silent-stale, miss →
+registered → next epoch serves) ride along, as does the zero-lock
+proof: a full serve sweep moves the aggregator-lock ledger by zero.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from tests.fixtures import lots_of_spans
+from tests.test_wal import CFG, make
+from zipkin_tpu.model.json_v2 import link_to_dict
+from zipkin_tpu.serving.segment import MirrorSegment
+from zipkin_tpu.serving.shape import (
+    SegmentMiss,
+    SegmentView,
+    StalenessExceeded,
+)
+from zipkin_tpu.storage.tpu import TpuStorage
+
+QS = (0.5, 0.9, 0.99)
+
+
+def J(x) -> str:
+    return json.dumps(x, sort_keys=True)
+
+
+def _ingest(store, n=400, seed=7):
+    spans = lots_of_spans(n, seed=seed, services=8, span_names=12)
+    store.span_consumer().accept(spans).execute()
+
+
+@pytest.fixture()
+def served(tmp_path):
+    """A store with an attached segment and one epoch published, plus a
+    SegmentView playing the reader role (same process, same protocol —
+    the cross-process legs live in test_serving_chaos.py)."""
+    store = make(tmp_path, wal=False, checkpoint=False)
+    seg = MirrorSegment(readers=2, capacity=4 << 20)
+    try:
+        _ingest(store)
+        store.attach_mirror_segment(seg)
+        assert store.publish_mirror(force=True)
+        yield store, seg, SegmentView(seg, 0)
+    finally:
+        seg.close()
+        store.close()
+
+
+def _serve(store, fn, *args, **kw):
+    """First touch of a novel key 503s and registers; the next publish
+    carries it — the reader contract. Retry once across a publish."""
+    try:
+        return fn(*args, **kw)[0]
+    except SegmentMiss:
+        assert store.publish_mirror(force=True)
+        return fn(*args, **kw)[0]
+
+
+# -- endpoint-by-endpoint byte parity --------------------------------------
+
+
+def test_quantiles_byte_parity_including_filters(served):
+    store, _seg, view = served
+    assert J(store.latency_quantiles(list(QS))) == J(
+        _serve(store, view.serve_quantiles, QS)
+    )
+    # a filtered read and an unknown-service read shape identically
+    assert J(store.latency_quantiles([0.5], service_name="svc00")) == J(
+        _serve(store, view.serve_quantiles, (0.5,), "svc00")
+    )
+    assert J(
+        store.latency_quantiles(list(QS), service_name="no-such-svc")
+    ) == J(_serve(store, view.serve_quantiles, QS, "no-such-svc"))
+    assert J(
+        store.latency_quantiles([0.5], span_name="op01")
+    ) == J(_serve(store, view.serve_quantiles, (0.5,), None, "op01"))
+
+
+def test_cardinalities_byte_parity(served):
+    store, _seg, view = served
+    assert J(store.trace_cardinalities()) == J(
+        _serve(store, view.serve_cardinalities)
+    )
+
+
+def test_dependencies_byte_parity(served):
+    store, _seg, view = served
+    end_ts = int(time.time() * 1000) + 86_400_000
+    lookback = 7 * 86_400_000
+    fresh = [
+        link_to_dict(l)
+        for l in store.get_dependencies(end_ts, lookback).execute()
+    ]
+    assert J(fresh) == J(
+        _serve(store, view.serve_dependencies, end_ts, lookback)
+    )
+
+
+def test_overview_byte_parity(served):
+    store, _seg, view = served
+    over = store.sketch_overview(list(QS))
+    got = _serve(store, view.serve_overview, QS)
+    assert J(over["percentiles"]) == J(got["percentiles"])
+    assert J(over["cardinalities"]) == J(got["cardinalities"])
+    # the counters block is the publish-instant ingest snapshot: same
+    # keys, values frozen at the epoch (ingest-side ones keep moving)
+    assert set(got["counters"]).issubset(set(store.ingest_counters()))
+
+
+def test_windowed_ttq_byte_parity(served):
+    """CFG enables the time tier by default, so a windowed read at
+    "now" routes through demand-registered ``ttq:`` keys on BOTH sides
+    — merged digests/HLLs must shape to the same bytes."""
+    store, _seg, view = served
+    now_ms = int(time.time() * 1000)
+    assert J(
+        store.latency_quantiles([0.5, 0.9], end_ts=now_ms, lookback=3_600_000)
+    ) == J(
+        _serve(
+            store, view.serve_quantiles, (0.5, 0.9), None, None, True,
+            now_ms, 3_600_000,
+        )
+    )
+    assert J(
+        store.trace_cardinalities(end_ts=now_ms, lookback=3_600_000)
+    ) == J(_serve(store, view.serve_cardinalities, None, now_ms, 3_600_000))
+
+
+def test_tenant_prefixed_key_parity(served):
+    """The segment is tenant-key transparent: a tenant-scoped mirror
+    key registered ingest-side serves through ``?tenant=`` with the
+    same bytes as the unscoped read it wraps."""
+    store, _seg, view = served
+    store.mirror.register(
+        "tenant:t1:card", lambda: store.agg.cardinalities(), pinned=True
+    )
+    assert store.publish_mirror(force=True)
+    assert J(_serve(store, view.serve_cardinalities, None, None, None, "t1")) \
+        == J(store.trace_cardinalities())
+
+
+# -- demand, staleness, and the zero-lock proof ----------------------------
+
+
+def test_demand_miss_registers_and_next_epoch_serves(served):
+    store, _seg, view = served
+    with pytest.raises(SegmentMiss) as ei:
+        view.serve_quantiles((0.25,))
+    assert ei.value.registered
+    # the publish tick drains reader demand FIRST, so the missed key is
+    # carried by the very next epoch
+    assert store.publish_mirror(force=True)
+    assert J(_serve(store, view.serve_quantiles, (0.25,))) == J(
+        store.latency_quantiles([0.25])
+    )
+    counters = store.ingest_counters()
+    assert counters["readerDemandRequests"] >= 1
+    assert counters["readerDemandOverflow"] == 0
+
+
+def test_tenant_demand_keys_are_refused_not_guessed(served):
+    """A reader miss on a tenant-prefixed key must NOT be auto-
+    registered (the publisher cannot infer a scoped compute closure) —
+    it is counted readerDemandUnparsed and keeps 503ing until the
+    ingest side registers it explicitly."""
+    store, _seg, view = served
+    with pytest.raises(SegmentMiss):
+        view.serve_cardinalities(None, None, None, "t9")
+    assert store.publish_mirror(force=True)
+    assert store.ingest_counters()["readerDemandUnparsed"] == 1
+    with pytest.raises(SegmentMiss):  # still not carried
+        view.serve_cardinalities(None, None, None, "t9")
+
+
+def test_staleness_bounds_are_hard_503s_never_silent_stale(served):
+    store, _seg, view = served
+    # fresh read demanded: a reader process cannot serve it — hard 503
+    with pytest.raises(StalenessExceeded) as ei:
+        view.serve_cardinalities(staleness_ms=0)
+    assert ei.value.fresh_required
+    # an impossible bound rejects with the real age in the error
+    with pytest.raises(StalenessExceeded) as ei:
+        view.serve_cardinalities(staleness_ms=1e-6)
+    assert not ei.value.fresh_required
+    assert ei.value.age_ms > ei.value.bound_ms
+    # a loose explicit bound serves and stamps the age
+    rows, age = view.serve_cardinalities(staleness_ms=60_000)
+    assert rows["_global"] > 0 and age >= 0.0
+    assert view.stale_rejects == 1 and view.fresh_rejects == 1
+
+
+def test_reader_serves_take_zero_aggregator_lock_acquisitions(served):
+    """The scale-out claim, measured: a full serve sweep through the
+    SegmentView moves the store's lock ledger by exactly zero."""
+    store, _seg, view = served
+    store.set_query_observatory(True)
+    end_ts = int(time.time() * 1000)
+    _serve(store, view.serve_dependencies, end_ts, 3_600_000)
+    before = store.ingest_counters()["queryLockAcquisitions"]
+    for _ in range(50):
+        view.serve_quantiles(QS)
+        view.serve_cardinalities()
+        view.serve_overview(QS)
+        view.serve_dependencies(end_ts, 3_600_000)
+    assert store.ingest_counters()["queryLockAcquisitions"] == before
+    assert view.serves >= 200
+    # quant/card/overview repeat serves are generation-memoized (deps
+    # rows arrive pre-shaped from the publisher — nothing to memoize)
+    assert view.memo_hits >= 3 * 49
+
+
+def test_publication_is_one_lock_hold_per_tick(served):
+    """Segment serialization must ride OUTSIDE the aggregator lock —
+    the sink is called after the mirror swap. One publish = one
+    acquisition, segment attached or not."""
+    store, _seg, _view = served
+    store.set_query_observatory(True)
+    base = store.ingest_counters()["queryLockAcquisitions"]
+    assert store.publish_mirror(force=True)
+    assert store.ingest_counters()["queryLockAcquisitions"] == base + 1
+
+
+# -- crash-resume: the boot publish reaches the segment --------------------
+
+
+def test_crash_resume_boot_publish_serves_readers_with_parity(tmp_path):
+    """Kill-and-reboot: the restored store's boot publish must land in
+    the segment BEFORE any reader could attach, and the first reader
+    serve after resume is byte-identical to the ingest-side read of the
+    restored state."""
+    store = make(tmp_path, wal=True, checkpoint=True)
+    _ingest(store, n=600, seed=11)
+    store.snapshot()
+    baseline = store.trace_cardinalities(staleness_ms=0)
+    del store  # crash: device state lost, WAL + checkpoint survive
+
+    resumed = TpuStorage(
+        config=CFG, num_devices=2, batch_size=512,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        wal_dir=str(tmp_path / "wal"),
+        mirror_segment_bytes=4 << 20,
+        mirror_segment_readers=2,
+    )
+    try:
+        seg = resumed.mirror_segment
+        assert seg is not None
+        # the boot epoch is already published: a reader attaching by
+        # params serves immediately, no warm publish needed
+        reader_seg = MirrorSegment.attach(seg.params())
+        try:
+            view = SegmentView(reader_seg, 1)
+            rows, _age = view.serve_cardinalities()
+            assert J(rows) == J(resumed.trace_cardinalities())
+            assert J(rows) == J(baseline)  # ...which IS the pre-crash state
+            qrows, _ = view.serve_quantiles(QS)
+            assert J(qrows) == J(resumed.latency_quantiles(list(QS)))
+        finally:
+            reader_seg.close()
+    finally:
+        resumed.close()
+
+
+def test_storage_close_retires_the_segment(tmp_path):
+    store = make(tmp_path, wal=False, checkpoint=False)
+    seg = MirrorSegment(readers=1, capacity=1 << 20)
+    store.attach_mirror_segment(seg)
+    store.publish_mirror(force=True)
+    assert store.mirror.segment_sink is not None
+    store.mirror.segment_sink = None
+    seg.close()
+    store.close()
+    # closing again is idempotent
+    seg.close()

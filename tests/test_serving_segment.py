@@ -1,0 +1,300 @@
+"""Shared-memory mirror segment: the serving tier's publication wire.
+
+The segment carries one serialized mirror epoch across process
+boundaries behind the PR 6 seqlock idiom (odd-at-claim / even-at-
+publish, CRC backstop, pid guard). These tests pin the protocol at the
+word level — round trip, overflow posture, crashed-claim recovery,
+torn/corrupt detection, the attach-by-name geometry handshake — plus
+the demand backchannel (bounded SPSC stripes) and the heartbeat plane
+the supervisor and /statusz read. Config bounds for the three serving
+knobs ride along (satellite f).
+"""
+
+from __future__ import annotations
+
+import pickle
+import zlib
+
+import pytest
+
+from zipkin_tpu.serving import segment as seg_mod
+from zipkin_tpu.serving.segment import MirrorSegment, SegmentUnavailable
+
+
+def _payload(**kw):
+    d = {"format": 1, "values": {"k": 1}}
+    d.update(kw)
+    return pickle.dumps(d, protocol=4)
+
+
+def _segment(**kw):
+    kw.setdefault("readers", 2)
+    kw.setdefault("capacity", 1 << 16)
+    return MirrorSegment(**kw)
+
+
+# -- seqlock publication round trip ---------------------------------------
+
+
+def test_write_read_round_trip_stamps_every_header_field():
+    seg = _segment()
+    try:
+        body = _payload()
+        assert seg.write(
+            body, mirror_generation=5, write_version=9, wall_ms=1234
+        )
+        fr = seg.read_frame()
+        assert pickle.loads(fr.payload) == pickle.loads(body)
+        assert fr.gen == 2 and fr.gen % 2 == 0  # even: stable epoch
+        assert fr.mirror_generation == 5
+        assert fr.write_version == 9
+        assert fr.wall_ms == 1234
+        assert fr.publishes == 1
+        # double-buffered: a second publish lands in the other buffer
+        # and the frame tracks it
+        body2 = _payload(values={"k": 2})
+        assert seg.write(body2, mirror_generation=6, write_version=10)
+        fr2 = seg.read_frame()
+        assert pickle.loads(fr2.payload)["values"] == {"k": 2}
+        assert fr2.gen == 4 and fr2.publishes == 2
+    finally:
+        seg.close()
+
+
+def test_never_published_raises_unavailable():
+    seg = _segment()
+    try:
+        with pytest.raises(SegmentUnavailable, match="never published"):
+            seg.read_frame()
+    finally:
+        seg.close()
+
+
+def test_oversized_payload_is_dropped_and_previous_epoch_keeps_serving():
+    seg = _segment(capacity=1 << 12)
+    try:
+        assert seg.write(_payload(), mirror_generation=1, write_version=1)
+        g = seg.generation()
+        assert not seg.write(
+            b"x" * ((1 << 12) + 1), mirror_generation=2, write_version=2
+        )
+        assert seg.status()["overflows"] == 1
+        # the generation never moved: the old epoch is still intact
+        assert seg.generation() == g
+        assert pickle.loads(seg.read_frame().payload)["values"] == {"k": 1}
+    finally:
+        seg.close()
+
+
+def test_write_re_evens_a_crashed_claim():
+    """A writer that died between the odd claim and the even publish
+    leaves gen odd forever; the NEXT writer's publish must absorb that
+    (re-even) instead of publishing a permanently-odd epoch."""
+    seg = _segment()
+    try:
+        seg.write(_payload(), mirror_generation=1, write_version=1)
+        seg._a[seg_mod.H_GEN] = int(seg._a[seg_mod.H_GEN]) + 1  # crash: odd
+        with pytest.raises(SegmentUnavailable, match="torn"):
+            seg.read_frame(spins=12, spin_sleep_s=0.0)
+        assert seg.write(_payload(), mirror_generation=2, write_version=2)
+        fr = seg.read_frame()
+        assert fr.gen % 2 == 0
+        assert fr.mirror_generation == 2
+    finally:
+        seg.close()
+
+
+def test_crc_corruption_is_a_torn_read_not_a_bad_decode():
+    """Flip payload bytes behind the header's back: the CRC backstop
+    must refuse the frame (503 path), never hand a corrupt pickle to
+    the reader."""
+    seg = _segment()
+    try:
+        seg.write(_payload(), mirror_generation=1, write_version=1)
+        buf = int(seg._a[seg_mod.H_BUF])
+        off = seg._buf0_off if buf == 0 else seg._buf1_off
+        seg._shm.buf[off:off + 4] = b"\xde\xad\xbe\xef"
+        # plain except (not pytest.raises): the handler's implicit
+        # `del e` drops the traceback, whose frame locals pin a numpy
+        # view of the mapping and would poison the close below
+        try:
+            seg.read_frame(spins=6, spin_sleep_s=0.0)
+            raise AssertionError("corrupt frame was served")
+        except SegmentUnavailable as e:
+            assert e.torn == 6  # every attempt failed the CRC
+            assert e.writer_alive  # we are the writer
+    finally:
+        seg.close()
+
+
+def test_crc_stamp_matches_payload():
+    seg = _segment()
+    try:
+        body = _payload()
+        seg.write(body, mirror_generation=1, write_version=1)
+        assert int(seg._a[seg_mod.H_CRC]) == zlib.crc32(body)
+    finally:
+        seg.close()
+
+
+# -- attach-by-name geometry handshake ------------------------------------
+
+
+def test_attach_by_name_reads_geometry_from_header_words():
+    """A name alone is a complete address: the attacher must recover
+    the creator's (readers, capacity, demand_slots, key_cap) from the
+    header, not trust its own defaults."""
+    seg = MirrorSegment(
+        readers=3, capacity=1 << 15, demand_slots=16, key_cap=96
+    )
+    try:
+        seg.write(_payload(), mirror_generation=1, write_version=1)
+        other = MirrorSegment(name=seg.name)
+        try:
+            assert other.readers == 3
+            assert other.capacity == 1 << 15
+            assert other.demand_slots == 16
+            assert other.key_cap == 96
+            assert pickle.loads(other.read_frame().payload)["values"] == {
+                "k": 1
+            }
+            # and the demand stripes line up: a push through the
+            # attached handle drains through the creator
+            assert other.demand_push(2, "card")
+            assert seg.demand_drain() == ["card"]
+        finally:
+            other.close()
+    finally:
+        seg.close()
+
+
+def test_attach_params_round_trip():
+    seg = _segment()
+    try:
+        seg.write(_payload(), mirror_generation=1, write_version=1)
+        other = MirrorSegment.attach(seg.params())
+        try:
+            assert other.read_frame().mirror_generation == 1
+        finally:
+            other.close()
+    finally:
+        seg.close()
+
+
+def test_attach_rejects_a_foreign_shm_block():
+    from multiprocessing import shared_memory
+
+    raw = shared_memory.SharedMemory(create=True, size=4096)
+    try:
+        with pytest.raises(ValueError, match="not a mirror segment"):
+            MirrorSegment(name=raw.name)
+    finally:
+        raw.close()
+        raw.unlink()
+
+
+# -- demand backchannel ----------------------------------------------------
+
+
+def test_demand_ring_is_bounded_per_reader_and_drains_in_order():
+    seg = MirrorSegment(readers=2, capacity=1 << 14, demand_slots=4)
+    try:
+        for i in range(4):
+            assert seg.demand_push(0, f"quant:digest:0.{i}")
+        assert not seg.demand_push(0, "overflowed")  # stripe full
+        assert seg.demand_push(1, "card")  # the OTHER stripe is fine
+        keys = seg.demand_drain()
+        assert keys == [f"quant:digest:0.{i}" for i in range(4)] + ["card"]
+        assert seg.demand_drain() == []  # drained; stripes reusable
+        assert seg.demand_push(0, "deps:0:60")
+        assert seg.demand_drain() == ["deps:0:60"]
+    finally:
+        seg.close()
+
+
+def test_demand_key_truncates_at_key_cap():
+    seg = MirrorSegment(readers=1, capacity=1 << 14, key_cap=16)
+    try:
+        assert seg.demand_push(0, "k" * 100)
+        assert seg.demand_drain() == ["k" * 16]
+    finally:
+        seg.close()
+
+
+# -- heartbeats / status ---------------------------------------------------
+
+
+def test_heartbeat_feeds_reader_status_and_generation_lag():
+    seg = _segment()
+    try:
+        seg.write(_payload(), mirror_generation=1, write_version=1)
+        seg.write(_payload(), mirror_generation=2, write_version=2)
+        # r0 saw only the first epoch (gen 2); segment is now at gen 4
+        seg.heartbeat(
+            0, gen_seen=2, serves=7, age_us=1500, demands=3,
+            demand_overflow=1, errors=0,
+        )
+        rows = seg.reader_status()
+        r0, r1 = rows[0], rows[1]
+        assert r0["alive"] and r0["serves"] == 7
+        assert r0["generationLag"] == 2
+        assert r0["lastServeAgeMs"] == 1.5
+        assert r0["demandRequests"] == 3 and r0["demandOverflow"] == 1
+        assert r1["pid"] == 0 and not r1["alive"]  # never heartbeat
+        st = seg.status()
+        assert st["publishes"] == 2 and st["writerAlive"]
+        assert st["name"] == seg.name
+    finally:
+        seg.close()
+
+
+def test_supervisor_words_ride_status():
+    seg = _segment()
+    try:
+        seg.note_supervisor(4242, 3)
+        st = seg.status()
+        assert st["supervisorPid"] == 4242 and st["respawns"] == 3
+    finally:
+        seg.close()
+
+
+# -- serving config knobs (satellite f) ------------------------------------
+
+
+def test_serving_env_knobs_parse_and_validate(monkeypatch):
+    from zipkin_tpu.server.config import ServerConfig
+
+    monkeypatch.setenv("TPU_READERS", "8")
+    monkeypatch.setenv("TPU_MIRROR_SEGMENT_BYTES", str(8 << 20))
+    monkeypatch.setenv("TPU_READER_PORT_BASE", "9700")
+    cfg = ServerConfig.from_env()
+    assert cfg.tpu_readers == 8
+    assert cfg.tpu_mirror_segment_bytes == 8 << 20
+    assert cfg.tpu_reader_port_base == 9700
+    # defaults: segment off, 4 reader stripes, base 9512
+    monkeypatch.delenv("TPU_READERS")
+    monkeypatch.delenv("TPU_MIRROR_SEGMENT_BYTES")
+    monkeypatch.delenv("TPU_READER_PORT_BASE")
+    cfg = ServerConfig.from_env()
+    assert cfg.tpu_mirror_segment_bytes == 0
+    assert cfg.tpu_readers == 4
+    assert cfg.tpu_reader_port_base == 9512
+
+
+@pytest.mark.parametrize(
+    "name,value",
+    [
+        ("TPU_READERS", "0"),
+        ("TPU_READERS", "65"),
+        ("TPU_MIRROR_SEGMENT_BYTES", "1024"),  # under the 64 KiB floor
+        ("TPU_MIRROR_SEGMENT_BYTES", str(2 << 30)),
+        ("TPU_READER_PORT_BASE", "80"),
+        ("TPU_READER_PORT_BASE", "70000"),
+    ],
+)
+def test_serving_env_knobs_refuse_out_of_bounds(monkeypatch, name, value):
+    from zipkin_tpu.server.config import ServerConfig
+
+    monkeypatch.setenv(name, value)
+    with pytest.raises(ValueError, match=name):
+        ServerConfig.from_env()

@@ -69,12 +69,13 @@ QSEG_LINK_RESOLVE = 6       # id->name vocab resolution into DependencyLinks
 QSEG_SERIALIZE = 7          # row shaping of device output into API objects
 QSEG_OTHER = 8              # derived: unstamped query time (gap sweep)
 QSEG_MIRROR_SERVE = 9       # lock-free serve from the epoch-published mirror
-N_QSEGS = 10
+QSEG_READER_SERVE = 10      # reader-process serve from the shm mirror segment
+N_QSEGS = 11
 
 QSEG_NAMES = (
     "lock_wait", "cache_probe", "device_dispatch", "device_wall",
     "readpack_transfer", "unpack", "link_resolve", "serialize", "other",
-    "mirror_serve",
+    "mirror_serve", "reader_serve",
 )
 _QWAIT = frozenset((QSEG_LOCK_WAIT, QSEG_OTHER))
 QSEG_KIND = tuple(

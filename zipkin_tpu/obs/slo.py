@@ -163,6 +163,16 @@ def default_specs(short_s: float = 60.0, long_s: float = 300.0,
         # quietly freeze in time.
         SloSpec("query_mirror_staleness", "gauge",
                 gauge="mirrorServeAgeMs", limit=5000.0, **kw),
+        # Scale-out reader processes (serving/, ISSUE 19): the same
+        # staleness contract one process boundary further out —
+        # readerServeAgeMs is the worst live reader's age-at-serve,
+        # relayed through the segment heartbeat stripes into
+        # ingest_counters. Inert at 0.0 with no readers attached; a
+        # trip with readers attached means the segment publisher
+        # stopped landing epochs (sink erroring, payload overflowing)
+        # while reader processes kept serving the last one.
+        SloSpec("reader_staleness", "gauge",
+                gauge="readerServeAgeMs", limit=5000.0, **kw),
     ]
 
 

@@ -48,6 +48,7 @@ STAGES = (
     "query_wall",        # stitched query critical path: request begin → result
     "query_mirror",      # lock-free serve from the epoch-published read mirror
     "mirror_publish",    # one mirror publish: lock once, packed reads, swap
+    "reader_serve",      # reader-process serve from the shm mirror segment
 )
 
 NUM_STAGES = len(STAGES)
@@ -83,6 +84,7 @@ DEFAULT_BUDGETS_US = {
     "query_wall": 150_000,
     "query_mirror": 10_000,
     "mirror_publish": 1_000_000,
+    "reader_serve": 10_000,
 }
 
 assert set(DEFAULT_BUDGETS_US) == set(STAGES)

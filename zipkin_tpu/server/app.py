@@ -104,6 +104,8 @@ def build_storage(config: ServerConfig) -> StorageComponent:
                 snapshot_keep=config.tpu_snapshot_keep,
                 scrub_interval_s=config.tpu_scrub_interval_s,
                 scrub_bytes_per_sec=config.tpu_scrub_bytes_per_sec,
+                mirror_segment_bytes=config.tpu_mirror_segment_bytes,
+                mirror_segment_readers=config.tpu_readers,
                 **common,
             )
 
@@ -1253,6 +1255,20 @@ class ZipkinServer:
             ):
                 if name in counters:
                     out[f"gauge.zipkin_tpu.{name}"] = counters[name]
+            # scale-out read serving (ISSUE 19): shm segment publication
+            # ledger + the reader-fleet heartbeat rollup (demand-ring
+            # traffic, max staleness over alive readers, respawns)
+            for name in (
+                "segmentGeneration", "segmentPublishes",
+                "segmentPublishErrors", "segmentOverflows",
+                "segmentSkippedKeys", "segmentPayloadBytes",
+                "segmentSerializeMs", "mirrorSegmentSinkErrors",
+                "readerRespawns", "readerDemandRequests",
+                "readerDemandOverflow", "readerDemandUnparsed",
+                "readerServeAgeMs", "readerGenerationLagMax",
+            ):
+                if name in counters:
+                    out[f"gauge.zipkin_tpu.{name}"] = counters[name]
         # sampling-tier gauges (ISSUE 4): retention verdict tallies, the
         # controller's budget posture, and the live per-service keep rate
         if getattr(self.storage, "sampler", None) is not None:
@@ -1477,6 +1493,14 @@ class ZipkinServer:
         # (generation, write version, age) + publish/serve ledger
         if self._mirror is not None:
             body["mirror"] = await asyncio.to_thread(self._mirror.status)
+        # scale-out read serving (ISSUE 19): shm segment generation,
+        # payload size, and the per-reader heartbeat table (generation
+        # lag, serve ages, demand-ring depth, respawn count) — the
+        # segment name is here so `python -m zipkin_tpu.serving` can be
+        # pointed at it (TPU_MIRROR_SEGMENT=<name>)
+        seg = getattr(self.storage, "mirror_segment", None)
+        if seg is not None:
+            body["serving"] = await asyncio.to_thread(seg.status)
         # overload control plane (ISSUE 13): ladder state, the live
         # signal fold, admission posture, and the transition history
         if self._overload is not None:

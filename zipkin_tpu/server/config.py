@@ -32,6 +32,20 @@ def _env_float(name: str, default: float) -> float:
     return float(raw) if raw else default
 
 
+def _bounded(name: str, value: int, lo: int, hi: int, *, allow_zero: bool = False) -> int:
+    # refuse-to-boot posture for structural knobs: a reader fleet or shm
+    # segment sized from a typo'd env var should fail loudly at config
+    # time, not OOM or spin at runtime
+    if allow_zero and value == 0:
+        return value
+    if not (lo <= value <= hi):
+        raise ValueError(
+            f"{name}={value} out of bounds [{lo}, {hi}]"
+            + (" (0 = disabled)" if allow_zero else "")
+        )
+    return value
+
+
 def _env_list(name: str) -> Tuple[str, ...]:
     raw = os.environ.get(name, "")
     return tuple(x.strip() for x in raw.split(",") if x.strip())
@@ -200,6 +214,19 @@ class ServerConfig:
     # SLO pages on.
     tpu_read_mirror: bool = True
     tpu_mirror_max_stale_ms: int = 5000
+    # scale-out read serving (zipkin_tpu.serving, ISSUE 19): when
+    # TPU_MIRROR_SEGMENT_BYTES > 0 the mirror publisher also serializes
+    # each epoch into a double-buffered shared-memory segment that
+    # stateless reader processes (python -m zipkin_tpu.serving) map
+    # read-only and serve from without ever touching the aggregator
+    # lock. TPU_READERS sizes the per-reader heartbeat/demand stripes
+    # the segment is created with (and is the reader-count default the
+    # serving front end inherits); TPU_READER_PORT_BASE is the first
+    # reader's HTTP port (reader rN listens on base+N, the supervisor's
+    # aggregate endpoint on base-1).
+    tpu_readers: int = 4
+    tpu_mirror_segment_bytes: int = 0
+    tpu_reader_port_base: int = 9512
     # deadline propagation (ISSUE 13): honor gRPC deadlines and the
     # X-Request-Timeout-Ms HTTP header at ingest + query entrypoints —
     # work already past its deadline is dropped before device dispatch
@@ -363,6 +390,22 @@ class ServerConfig:
             tpu_read_mirror=_env_bool("TPU_READ_MIRROR", True),
             tpu_mirror_max_stale_ms=_env_int(
                 "TPU_MIRROR_MAX_STALE_MS", 5000
+            ),
+            tpu_readers=_bounded(
+                "TPU_READERS", _env_int("TPU_READERS", 4), 1, 64
+            ),
+            tpu_mirror_segment_bytes=_bounded(
+                "TPU_MIRROR_SEGMENT_BYTES",
+                _env_int("TPU_MIRROR_SEGMENT_BYTES", 0),
+                64 << 10,
+                1 << 30,
+                allow_zero=True,
+            ),
+            tpu_reader_port_base=_bounded(
+                "TPU_READER_PORT_BASE",
+                _env_int("TPU_READER_PORT_BASE", 9512),
+                1025,  # base-1 hosts the supervisor endpoint, keep it unprivileged
+                65000,
             ),
             deadline_propagation_enabled=_env_bool("TPU_DEADLINES", True),
             tpu_resume_dir=resume_dir,

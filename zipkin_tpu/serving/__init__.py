@@ -1,0 +1,11 @@
+"""Scale-out read serving (ISSUE 19).
+
+The ingest process's mirror publisher serializes each epoch into a
+shared-memory segment (`segment.py`); stateless reader processes map it
+read-only and serve the query API without ever entering the ingest
+process (`shape.py`, `reader.py`); a tiny supervisor spawns and
+respawns them (`supervisor.py`, ``python -m zipkin_tpu.serving``).
+
+Everything importable from a reader process is numpy + stdlib (+
+aiohttp for the HTTP front end) — no jax, no store, no aggregator.
+"""

@@ -1,0 +1,100 @@
+"""``python -m zipkin_tpu.serving``: the multi-process reader front end.
+
+Attaches the ingest process's mirror segment by name and runs the
+reader supervisor in the foreground, plus a small aggregate HTTP
+surface (``/metrics``, ``/prometheus``, ``/statusz``) on
+``TPU_READER_PORT_BASE - 1`` that fans out to the reader-labeled
+per-reader families.
+
+Environment (validated by `server/config.py` when launched with the
+ingest server; re-read here for the standalone front end):
+
+- ``TPU_MIRROR_SEGMENT``      shm name the ingest server printed /
+                              exposed in its ``/statusz`` serving block
+                              (required)
+- ``TPU_READERS``             reader process count (default 2)
+- ``TPU_READER_PORT_BASE``    first reader port (default 9512)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+from aiohttp import web
+
+from zipkin_tpu.serving.segment import MirrorSegment
+from zipkin_tpu.serving.supervisor import ReaderSupervisor
+
+logger = logging.getLogger(__name__)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    seg_name = os.environ.get("TPU_MIRROR_SEGMENT", "").strip()
+    if not seg_name:
+        print(
+            "TPU_MIRROR_SEGMENT is required: the shm segment name the "
+            "ingest server exposes in /api/v2/tpu/statusz under "
+            '"serving.segment"', file=sys.stderr,
+        )
+        return 2
+    readers = max(1, min(64, _env_int("TPU_READERS", 2)))
+    port_base = _env_int("TPU_READER_PORT_BASE", 9512)
+    segment = MirrorSegment(name=seg_name)
+    sup = ReaderSupervisor(segment, readers, port_base)
+    sup.start()
+
+    async def get_metrics(request: web.Request) -> web.Response:
+        return web.json_response(sup.scrape_metrics())
+
+    async def get_prometheus(request: web.Request) -> web.Response:
+        return web.Response(
+            text=sup.scrape_prometheus(),
+            content_type="text/plain", charset="utf-8",
+        )
+
+    async def get_statusz(request: web.Request) -> web.Response:
+        return web.json_response(json.loads(json.dumps(sup.status())))
+
+    async def on_cleanup(app_: web.Application) -> None:
+        sup.stop()
+        segment.close()
+
+    async def supervise(app_: web.Application):
+        import asyncio
+
+        async def loop() -> None:
+            while True:
+                sup.poll()
+                await asyncio.sleep(0.5)
+
+        task = asyncio.create_task(loop())
+        yield
+        task.cancel()
+
+    app = web.Application()
+    app.router.add_get("/metrics", get_metrics)
+    app.router.add_get("/prometheus", get_prometheus)
+    app.router.add_get("/statusz", get_statusz)
+    app.cleanup_ctx.append(supervise)
+    app.on_cleanup.append(on_cleanup)
+    logger.info(
+        "serving front end: %d readers on %d.., aggregate on %d",
+        readers, port_base, port_base - 1,
+    )
+    web.run_app(app, host="127.0.0.1", port=port_base - 1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

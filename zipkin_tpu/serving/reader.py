@@ -1,0 +1,258 @@
+"""Stateless reader process: the async HTTP front end over one SegmentView.
+
+Each reader maps the mirror segment read-only and serves the four
+sketch read endpoints on its own port — byte-compatible routes and
+parameters with `server/app.py` (``/api/v2/dependencies``,
+``/api/v2/tpu/percentiles|cardinalities|overview``), plus ``/metrics``
+and ``/prometheus`` for the supervisor's reader-labeled aggregation.
+Queries never enter the ingest process: every answer comes from the
+shared-memory epoch, stamped with its real staleness
+(``X-Staleness-Ms``), and anything the epoch cannot answer within
+bounds is a 503 with Retry-After — a mirror-key miss (demanded back to
+the publisher, carried next tick), an over-bound epoch age, a
+requested-fresh read, or a segment torn/unpublished too long. Never a
+silent stale answer.
+
+Serves run directly on the asyncio loop — a serve is a header-word
+compare plus a dict hit on the per-generation memo, so there is
+nothing to offload to a thread (and no lock for one to contend on;
+ZT13 proves the whole chain lock-free statically).
+
+Spawn entry: :func:`run_reader` (module-level, importable without jax).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from zipkin_tpu.serving.segment import MirrorSegment, SegmentUnavailable
+from zipkin_tpu.serving.shape import (
+    SegmentMiss, SegmentView, StalenessExceeded,
+)
+
+_RETRY_AFTER_S = 1  # one publish tick; misses and swaps resolve by then
+
+
+def _unavailable(reason: str, retry_after_s: int = _RETRY_AFTER_S,
+                 **headers) -> web.Response:
+    h = {"Retry-After": str(retry_after_s)}
+    h.update({k: str(v) for k, v in headers.items()})
+    return web.Response(status=503, text=reason, headers=h)
+
+
+_CAMEL = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def _snake(name: str) -> str:
+    return _CAMEL.sub("_", name).lower()
+
+
+class ReaderApp:
+    """One reader's handlers; state is the SegmentView alone."""
+
+    def __init__(self, view: SegmentView, port: int = 0,
+                 default_lookback: int = 86400000) -> None:
+        self.view = view
+        self.port = port
+        self.default_lookback = default_lookback
+        self.started_at = time.monotonic()
+
+    def build(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/api/v2/dependencies", self.get_dependencies)
+        r.add_get("/api/v2/tpu/percentiles", self.get_percentiles)
+        r.add_get("/api/v2/tpu/cardinalities", self.get_cardinalities)
+        r.add_get("/api/v2/tpu/overview", self.get_overview)
+        r.add_get("/health", self.get_health)
+        r.add_get("/metrics", self.get_metrics)
+        r.add_get("/prometheus", self.get_prometheus)
+        return app
+
+    # -- request plumbing --------------------------------------------------
+
+    @staticmethod
+    def _staleness_param(request: web.Request) -> Optional[float]:
+        raw = request.query.get("staleness_ms")
+        return float(raw) if raw is not None else None
+
+    def _serve(self, fn, *args, **kwargs) -> web.Response:  # zt-reader-process: the 503 contract — miss/over-bound/torn all surface, none serve silently
+        try:
+            body, age_ms = fn(*args, **kwargs)
+        except SegmentMiss as e:
+            self.view.errors += 1
+            return _unavailable(
+                f"epoch does not carry {e.key!r} yet"
+                + ("; registered for the next publish" if e.registered
+                   else "; demand stripe full, retry"),
+            )
+        except StalenessExceeded as e:
+            self.view.errors += 1
+            if e.fresh_required:
+                return _unavailable(
+                    "staleness_ms<=0 demands a fresh read; readers serve "
+                    "published epochs only — query the ingest server",
+                )
+            return _unavailable(
+                f"epoch age {e.age_ms:.1f}ms exceeds bound "
+                f"{e.bound_ms:.1f}ms",
+                retry_after_s=max(
+                    _RETRY_AFTER_S,
+                    int(math.ceil((e.age_ms - e.bound_ms) / 1000.0)),
+                ),
+            )
+        except SegmentUnavailable as e:
+            self.view.unavailable += 1
+            self.view.errors += 1
+            return _unavailable(
+                f"segment unavailable: {e.reason}",
+                **{"X-Writer-Alive": int(e.writer_alive)},
+            )
+        return web.json_response(
+            body, headers={"X-Staleness-Ms": f"{age_ms:.3f}"}
+        )
+
+    # -- endpoints ---------------------------------------------------------
+
+    async def get_dependencies(self, request: web.Request) -> web.Response:
+        raw_end = request.query.get("endTs")
+        if not raw_end:
+            return web.Response(status=400, text="endTs parameter is required")
+        try:
+            end_ts = int(raw_end)
+            lookback = int(
+                request.query.get("lookback") or self.default_lookback
+            )
+            staleness = self._staleness_param(request)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        return self._serve(
+            self.view.serve_dependencies, end_ts, lookback, staleness,
+            request.query.get("tenant"),
+        )
+
+    async def get_percentiles(self, request: web.Request) -> web.Response:
+        raw_q = request.query.get("q", "0.5,0.9,0.99")
+        try:
+            qs = [float(x) for x in raw_q.split(",") if x]
+            if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
+                raise ValueError(f"q out of range: {raw_q!r}")
+            end_ts = request.query.get("endTs")
+            lookback = request.query.get("lookback")
+            end_ts = int(end_ts) if end_ts is not None else None
+            lookback = int(lookback) if lookback is not None else None
+            staleness = self._staleness_param(request)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        return self._serve(
+            self.view.serve_quantiles,
+            qs,
+            request.query.get("serviceName"),
+            request.query.get("spanName"),
+            request.query.get("sketch", "digest") == "digest",
+            end_ts,
+            lookback,
+            staleness,
+            request.query.get("tenant"),
+        )
+
+    async def get_cardinalities(self, request: web.Request) -> web.Response:
+        try:
+            staleness = self._staleness_param(request)
+            end_ts = request.query.get("endTs")
+            lookback = request.query.get("lookback")
+            end_ts = int(end_ts) if end_ts is not None else None
+            lookback = int(lookback) if lookback is not None else None
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        return self._serve(
+            self.view.serve_cardinalities, staleness, end_ts, lookback,
+            request.query.get("tenant"),
+        )
+
+    async def get_overview(self, request: web.Request) -> web.Response:
+        raw_q = request.query.get("q", "0.5,0.9,0.99")
+        try:
+            qs = [float(x) for x in raw_q.split(",") if x]
+            if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
+                raise ValueError(f"q out of range: {raw_q!r}")
+            staleness = self._staleness_param(request)
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        return self._serve(
+            self.view.serve_overview,
+            qs,
+            request.query.get("serviceName"),
+            request.query.get("spanName"),
+            staleness,
+            request.query.get("tenant"),
+        )
+
+    # -- ops ---------------------------------------------------------------
+
+    async def get_health(self, request: web.Request) -> web.Response:
+        try:
+            self.view.refresh()
+        except SegmentUnavailable as e:
+            return web.json_response(
+                {"status": "DOWN", "reason": e.reason},
+                status=503, headers={"Retry-After": str(_RETRY_AFTER_S)},
+            )
+        return web.json_response({
+            "status": "UP",
+            "reader": f"r{self.view.reader_idx}",
+            "generation": self.view.counters()["readerGeneration"],
+        })
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        body = dict(self.view.counters())
+        body["readerPid"] = os.getpid()
+        body["readerPort"] = self.port
+        body["readerUptimeS"] = round(
+            time.monotonic() - self.started_at, 3
+        )
+        return web.json_response({"reader": body})
+
+    async def get_prometheus(self, request: web.Request) -> web.Response:
+        label = f'reader="r{self.view.reader_idx}"'
+        lines = []
+        for name, value in self.view.counters().items():
+            if isinstance(value, bool):
+                value = int(value)
+            if not isinstance(value, (int, float)):
+                continue
+            lines.append(
+                f"zipkin_tpu_{_snake(name)}{{{label}}} {value}"
+            )
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/plain", charset="utf-8",
+        )
+
+
+def run_reader(
+    seg_params: dict,
+    reader_idx: int,
+    port: int,
+    default_lookback: int = 86400000,
+) -> None:  # zt-reader-process: spawn entry — attaches the segment and serves; imports numpy/stdlib/aiohttp, never jax or the store
+    """Blocking reader main (the supervisor's spawn target)."""
+    segment = MirrorSegment.attach(seg_params)
+    view = SegmentView(segment, reader_idx)
+    app = ReaderApp(view, port=port, default_lookback=default_lookback)
+    try:
+        web.run_app(
+            app.build(), host="127.0.0.1", port=port,
+            print=None, handle_signals=True,
+        )
+    finally:
+        # drop the numpy control-word views before interpreter shutdown
+        # GCs the SharedMemory object — otherwise its __del__ races the
+        # exported buffer pointers and spams BufferError on every exit
+        segment.close()

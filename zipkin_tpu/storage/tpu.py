@@ -45,6 +45,8 @@ class TpuStorage(_CoreTpuStorage):
         snapshot_keep: int = 2,
         scrub_interval_s: float = 0.0,
         scrub_bytes_per_sec: int = 8 << 20,
+        mirror_segment_bytes: int = 0,
+        mirror_segment_readers: int = 4,
     ) -> None:
         mesh = None
         if num_devices is not None:
@@ -152,6 +154,21 @@ class TpuStorage(_CoreTpuStorage):
         # from the durable span count — the last leg of the boot-time
         # restore sequence (snapshot -> WAL replay -> transport offset)
         self.resume_offset = int(self.agg.host_counters.get("spans", 0))
+        # scale-out read serving (serving/, ISSUE 19): create the shm
+        # mirror segment BEFORE the boot publish below, so the very
+        # first epoch — including a crash-resume's restored state —
+        # lands in shared memory and reader processes attaching at any
+        # point after boot serve it byte-identically to the in-process
+        # mirror (tests/test_serving_parity.py).
+        self.mirror_segment = None
+        if mirror_segment_bytes > 0:
+            from zipkin_tpu.serving.segment import MirrorSegment
+
+            self.mirror_segment = MirrorSegment(
+                readers=mirror_segment_readers,
+                capacity=mirror_segment_bytes,
+            )
+            self.attach_mirror_segment(self.mirror_segment)
         # cut the first mirror epoch from the restored state BEFORE the
         # ticker exists: the first post-boot dashboard read serves
         # lock-free from a snapshot that already reflects the resumed
@@ -291,4 +308,11 @@ class TpuStorage(_CoreTpuStorage):
                 # reused aggregator could append to a closed file
                 self.agg.wal_hook = None
                 wal.close()
+            seg = getattr(self, "mirror_segment", None)
+            if seg is not None:
+                # detach the sink first so a late ticker publish cannot
+                # write through a closed shm mapping
+                self.mirror.segment_sink = None
+                seg.close()
+                self.mirror_segment = None
             super().close()

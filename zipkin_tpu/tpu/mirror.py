@@ -165,6 +165,12 @@ class ReadMirror:
         self.serve_age_ms = 0.0
         self.serve_age_max_ms = 0.0
         self.demand_overflow = 0
+        # scale-out seam (serving/, ISSUE 19): called with each newly
+        # published snapshot AFTER the swap — outside the aggregator
+        # lock, so shm serialization can never stretch the one hold.
+        # The store installs it via attach_mirror_segment().
+        self.segment_sink: Optional[Callable] = None
+        self.segment_sink_errors = 0
 
     # -- demand registry (serving threads) -------------------------------
 
@@ -321,6 +327,15 @@ class ReadMirror:
         self._publish_done_at = time.monotonic()
         self.publish_ms_sum += publish_ms
         obs.record("mirror_publish", publish_ms / 1000.0)
+        sink = self.segment_sink
+        if sink is not None:
+            try:
+                sink(new)
+            except Exception:
+                # the shm epoch lags one publish; in-process serving is
+                # unaffected — never abort the epoch for the segment
+                self.segment_sink_errors += 1
+                logger.exception("mirror publish: segment sink failed")
         with self._demand_lock:
             for k, ent in list(self._demand.items()):
                 if not ent[2] and (

@@ -310,6 +310,14 @@ class TpuStorage(
         # lazily for the same reason the querytrace lock provider does.
         self.mirror = ReadMirror(lambda: getattr(self, "agg", None))
         self._seed_mirror()
+        # scale-out read serving (serving/, ISSUE 19): when a shm
+        # mirror segment is attached, every mirror epoch additionally
+        # serializes into it (outside the aggregator lock) and reader
+        # PROCESSES serve from the mapped copy; their missed keys come
+        # back through the segment's demand stripes each tick.
+        self._segment = None
+        self._segment_publisher = None
+        self._demand_unparsed = 0
         # time-disaggregated sketch tier (tpu/timetier.py, ISSUE 15):
         # a ticker-driven sealer freezes finished device time buckets
         # into host-side mergeable segments; windowed [lookback, endTs]
@@ -1151,8 +1159,102 @@ class TpuStorage(
                        paced: bool = False) -> bool:
         """One mirror epoch (see ReadMirror.publish): the windows ticker
         calls this each tick (``paced=True`` — the duty-cycle cap); the
-        resume adapter calls it at boot."""
+        resume adapter calls it at boot. Reader-process demand drains
+        FIRST, so a key a reader missed is carried by this very epoch —
+        a shm-side miss costs one tick, like an in-process miss costs
+        one lock-path read."""
+        pub = self._segment_publisher
+        if pub is not None:
+            for key in pub.drain_demand():
+                self.mirror_register_key(key)
         return self.mirror.publish(force=force, paced=paced)
+
+    def attach_mirror_segment(self, segment) -> None:
+        """Wire a shm mirror segment (serving/segment.py) into the
+        publish path: each ReadMirror epoch is sanitized + serialized
+        into the segment AFTER the snapshot swap — outside the
+        aggregator lock, so publication stays ONE hold per tick. Call
+        before the boot publish so crash-resume readers attach to a
+        segment that already carries the restored epoch."""
+        from zipkin_tpu.serving.publisher import SegmentPublisher
+
+        pub = SegmentPublisher(segment)
+        self._segment = segment
+        self._segment_publisher = pub
+
+        def sink(snap) -> None:
+            tt = self.timetier
+            pub.publish_snapshot(
+                snap,
+                vocab=self.vocab,
+                max_stale_ms=self.mirror.max_stale_ms,
+                deps_max_stale_ms=self._deps_max_stale_ms,
+                time_bucket_minutes=self.config.time_bucket_minutes,
+                global_hll_row=self.config.global_hll_row,
+                tt_sealed_through=(
+                    tt.sealed_through if tt is not None else None
+                ),
+                counters=self.ingest_counters(),
+                mirror_generation=self.mirror.gen,
+            )
+
+        self.mirror.segment_sink = sink
+
+    def mirror_register_key(self, key: str) -> bool:
+        """Parse a reader-demanded mirror key string back into its
+        compute closure and register it (unpinned, TTL'd — exactly the
+        PR 14 demand-registry contract). The grammar is the closed set
+        of key forms the store itself mints; anything else (including
+        tenant-prefixed keys, whose scoped read planes do not exist
+        yet) is refused and counted, never guessed at."""
+        try:
+            if key == "card":
+                return self.mirror.register(
+                    key, lambda: self.agg.cardinalities()
+                )
+            if key.startswith("overview:"):
+                qs = tuple(
+                    float(x) for x in key.split(":", 1)[1].split(",") if x
+                )
+                if qs:
+                    return self.mirror.register(
+                        key, lambda: self.agg.sketch_overview(qs)
+                    )
+            if key.startswith("quant:w:"):
+                _, _, lo, hi, qstr = key.split(":", 4)
+                lo_min, hi_min = int(lo), int(hi)
+                qs = tuple(float(x) for x in qstr.split(",") if x)
+                if qs:
+                    return self.mirror.register(
+                        key,
+                        lambda: self.agg.quantiles(
+                            qs, ts_lo_min=lo_min, ts_hi_min=hi_min
+                        ),
+                    )
+            elif key.startswith("quant:"):
+                _, src, qstr = key.split(":", 2)
+                qs = tuple(float(x) for x in qstr.split(",") if x)
+                if src in ("digest", "hist") and qs:
+                    return self.mirror.register(
+                        key, lambda: self.agg.quantiles(qs, source=src)
+                    )
+            if key.startswith("deps:"):
+                _, lo, hi = key.split(":")
+                lo_min, hi_min = int(lo), int(hi)
+                return self.mirror.register(
+                    key, lambda: self._dependency_links(lo_min, hi_min)
+                )
+            if key.startswith("ttq:") and self.timetier is not None:
+                _, lo, hi = key.split(":")
+                lo_ep, hi_ep = int(lo), int(hi)
+                return self.mirror.register(
+                    key,
+                    lambda: self.timetier.window(self.agg, lo_ep, hi_ep),
+                )
+        except (ValueError, TypeError):
+            pass
+        self._demand_unparsed += 1
+        return False
 
     def _mirror_bound(
         self, staleness_ms: Optional[float], default_ms: float
@@ -1764,6 +1866,17 @@ class TpuStorage(
             # gauges — mirrorServeAgeMs backs the query_mirror_staleness
             # SLO and the zipkin_tpu_mirror_* prometheus families
             **self.mirror.counters(),
+            # scale-out serving segment (serving/, ISSUE 19): publish /
+            # overflow / demand-backchannel tallies plus the worst live
+            # reader's age-at-serve (readerServeAgeMs — backs the
+            # reader_staleness SLO) and generation lag
+            "mirrorSegmentSinkErrors": self.mirror.segment_sink_errors,
+            "readerDemandUnparsed": self._demand_unparsed,
+            **(
+                self._segment_publisher.counters()
+                if self._segment_publisher is not None
+                else {}
+            ),
             # time-disaggregated sketch tier (ttSeals / ttSegments* /
             # ttWindowReads / ttMissingEpochs ...): seal cadence, ring
             # occupancy, and windowed-read merge cost
